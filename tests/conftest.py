@@ -1,0 +1,17 @@
+"""Test configuration.
+
+Per SURVEY.md §4(d)'s rebuild test plan, CI needs no TPU: the JAX test
+suite runs on the CPU backend with 8 fake devices so multi-chip sharding
+logic (or-reduce, shard_map meshes) is exercised the same way
+``__graft_entry__.dryrun_multichip`` validates it. These env vars MUST be
+set before jax imports.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

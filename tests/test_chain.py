@@ -1,0 +1,120 @@
+"""Chain primitive tests: pure functions vs hashlib + published vectors.
+
+SURVEY.md §4 rebuild plan item (a): the reference can't help here (its PoW
+is a toy min-hash); real Bitcoin semantics are validated against the real
+genesis block and hashlib.
+"""
+
+import hashlib
+import os
+import struct
+
+import pytest
+
+from tpuminter import chain
+
+
+def test_sha256_compress_matches_hashlib_one_block():
+    # A 55-byte message fits one padded block: len ≤ 55 → block = msg ‖ 0x80 ‖ zeros ‖ len64.
+    msg = bytes(range(55))
+    block = msg + b"\x80" + b"\x00" * (64 - 55 - 1 - 8) + struct.pack(">Q", len(msg) * 8)
+    state = chain.sha256_compress(chain.SHA256_H0, block)
+    digest = struct.pack(">8I", *state)
+    assert digest == hashlib.sha256(msg).digest()
+
+
+def test_sha256_compress_matches_hashlib_multi_block():
+    msg = os.urandom(64 * 3)  # exactly 3 blocks + 1 padding block
+    state = chain.SHA256_H0
+    for i in range(3):
+        state = chain.sha256_compress(state, msg[64 * i : 64 * (i + 1)])
+    pad = b"\x80" + b"\x00" * (64 - 1 - 8) + struct.pack(">Q", len(msg) * 8)
+    state = chain.sha256_compress(state, pad)
+    assert struct.pack(">8I", *state) == hashlib.sha256(msg).digest()
+
+
+def test_midstate_continues_to_header_hash():
+    header = chain.GENESIS_HEADER.pack()
+    mid = chain.midstate(header[:64])
+    # second block: last 16 header bytes + padding for an 80-byte message
+    tail = header[64:] + b"\x80" + b"\x00" * (64 - 16 - 1 - 8) + struct.pack(">Q", 80 * 8)
+    state = chain.sha256_compress(mid, tail)
+    assert struct.pack(">8I", *state) == hashlib.sha256(header).digest()
+
+
+def test_genesis_block_hash():
+    assert chain.GENESIS_HEADER.pack().__len__() == 80
+    assert chain.hash_to_hex(chain.GENESIS_HEADER.block_hash()) == chain.GENESIS_HASH_HEX
+    assert chain.GENESIS_HEADER.meets_target()
+
+
+def test_genesis_wrong_nonce_fails_target():
+    assert not chain.GENESIS_HEADER.with_nonce(0).meets_target()
+
+
+def test_header_roundtrip():
+    h = chain.GENESIS_HEADER
+    assert chain.BlockHeader.unpack(h.pack()) == h
+
+
+def test_bits_to_target_difficulty_one():
+    target = chain.bits_to_target(0x1D00FFFF)
+    assert target == 0xFFFF * (1 << (8 * (0x1D - 3)))
+    assert f"{target:064x}".startswith("00000000ffff")
+    assert chain.target_to_bits(target) == 0x1D00FFFF
+
+
+def test_target_to_bits_mantissa_carry():
+    # A target whose top mantissa byte has the sign bit set must re-normalize.
+    bits = chain.target_to_bits(0x80FFFF << 8)
+    assert chain.bits_to_target(bits) <= 0x80FFFF << 8
+    assert not (bits & 0x00800000)
+
+
+def test_tail_words_match_packed_bytes():
+    h = chain.GENESIS_HEADER
+    raw = h.pack()
+    w0, w1, w2 = h.tail_words()
+    assert struct.pack(">3I", w0, w1, w2) == raw[64:76]
+    # word 3 of the second block is the byte-swapped nonce
+    (w3,) = struct.unpack(">I", raw[76:80])
+    assert w3 == int.from_bytes(struct.pack("<I", h.nonce), "big")
+
+
+def test_merkle_root_basics():
+    a, b, c = (bytes([i]) * 32 for i in (1, 2, 3))
+    assert chain.merkle_root([a]) == a
+    assert chain.merkle_root([a, b]) == chain.dsha256(a + b)
+    # odd level duplicates the last element
+    assert chain.merkle_root([a, b, c]) == chain.dsha256(
+        chain.dsha256(a + b) + chain.dsha256(c + c)
+    )
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 8, 13])
+@pytest.mark.parametrize("index", [0, 1])
+def test_merkle_branch_folds_to_root(n, index):
+    if index >= n:
+        pytest.skip("leaf index out of range")
+    txids = [os.urandom(32) for _ in range(n)]
+    root = chain.merkle_root(txids)
+    branch = chain.merkle_branch(txids, index=index)
+    assert chain.merkle_root_from_branch(txids[index], branch, index=index) == root
+
+
+def test_coinbase_template_rolls_merkle_root():
+    cb = chain.CoinbaseTemplate(prefix=b"\x01" * 40, suffix=b"\x02" * 60)
+    others = [os.urandom(32) for _ in range(3)]
+    for extranonce in (0, 1, 0xDEADBEEF):
+        txids = [cb.txid(extranonce)] + others
+        branch = chain.merkle_branch(txids, index=0)
+        assert cb.merkle_root(extranonce, branch) == chain.merkle_root(txids)
+
+
+def test_toy_hash_matches_definition():
+    data = b"hello mining"
+    nonce = 12345
+    digest = hashlib.sha256(data + struct.pack(">Q", nonce)).digest()
+    assert chain.toy_hash(data, nonce) == int.from_bytes(digest[:8], "big")
+    # deterministic + spread
+    assert chain.toy_hash(data, nonce) != chain.toy_hash(data, nonce + 1)

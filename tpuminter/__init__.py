@@ -1,0 +1,22 @@
+"""tpuminter — a TPU-native distributed proof-of-work mining framework.
+
+A from-scratch rebuild of the capabilities of
+``minhtrangvy/distributed_bitcoin_minter`` (see SURVEY.md; the reference
+mount was empty — SURVEY.md §0 — so all "≙ reference ..." notes in this
+package cite *expected* reference paths from SURVEY.md §2, not verified
+file:line locations).
+
+Architecture (two planes, SURVEY.md §7):
+
+- **Control plane** (pure Python, asyncio): client / coordinator / worker
+  roles exchanging Join/Request/Result over an LSP-capability-equivalent
+  reliable-UDP message layer with heartbeats, liveness detection, and a
+  fault-injectable transport seam (``tpuminter.lsp``).
+- **Data plane** (JAX/XLA/Pallas): the per-worker brute-force hash loop
+  becomes a vmapped Pallas double-SHA-256 kernel sharded over a TPU mesh
+  (``tpuminter.ops``, ``tpuminter.kernels``, ``tpuminter.parallel``), with
+  an ICI or-reduce for pod-wide early exit and on-device extraNonce /
+  Merkle-root rolling.
+"""
+
+__version__ = "0.1.0"
