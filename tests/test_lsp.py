@@ -1,0 +1,296 @@
+"""LSP protocol tests (SURVEY.md §4: connect, ordered delivery, window
+enforcement, epoch retransmit under injected loss, connection-loss
+detection, heartbeat liveness — multi-node faked on localhost, faults
+injected at the transport seam)."""
+
+import asyncio
+
+import pytest
+
+from tpuminter.lsp import (
+    Frame,
+    LspClient,
+    LspConnectError,
+    LspConnectionLost,
+    LspServer,
+    MsgType,
+    Params,
+    decode,
+    encode,
+)
+
+FAST = Params(epoch_limit=5, epoch_millis=40, window_size=4, max_backoff_interval=2)
+
+
+def run(coro, timeout=30.0):
+    async def wrapped():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.run(wrapped())
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+def test_codec_roundtrip():
+    f = Frame(MsgType.DATA, 7, 42, b"payload bytes")
+    assert decode(encode(f)) == f
+
+
+def test_codec_rejects_garbage_and_corruption():
+    assert decode(b"") is None
+    assert decode(b"short") is None
+    good = encode(Frame(MsgType.DATA, 1, 1, b"x" * 20))
+    flipped = bytes([good[0]]) + good[1:-1] + bytes([good[-1] ^ 0xFF])
+    assert decode(flipped) is None
+    truncated = good[:-3]
+    assert decode(truncated) is None
+
+
+# ---------------------------------------------------------------------------
+# happy path
+# ---------------------------------------------------------------------------
+
+def test_connect_and_echo_in_order():
+    async def scenario():
+        server = await LspServer.create(params=FAST)
+        client = await LspClient.connect("127.0.0.1", server.port, FAST)
+        assert client.conn_id >= 1
+        for i in range(20):
+            client.write(f"msg-{i}".encode())
+        for i in range(20):
+            conn_id, payload = await server.read()
+            assert payload == f"msg-{i}".encode()
+            server.write(conn_id, b"echo:" + payload)
+        for i in range(20):
+            assert await client.read() == f"echo:msg-{i}".encode()
+        await client.close()
+        await server.close()
+
+    run(scenario())
+
+
+def test_multiple_clients_demuxed():
+    async def scenario():
+        server = await LspServer.create(params=FAST)
+        clients = [
+            await LspClient.connect("127.0.0.1", server.port, FAST) for _ in range(4)
+        ]
+        ids = {c.conn_id for c in clients}
+        assert len(ids) == 4
+        for c in clients:
+            c.write(f"hello from {c.conn_id}".encode())
+        seen = {}
+        for _ in range(4):
+            conn_id, payload = await server.read()
+            seen[conn_id] = payload
+        assert seen == {c.conn_id: f"hello from {c.conn_id}".encode() for c in clients}
+        for c in clients:
+            await c.close()
+        await server.close()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# fault injection at the transport seam
+# ---------------------------------------------------------------------------
+
+def test_retransmission_survives_heavy_loss():
+    async def scenario():
+        server = await LspServer.create(params=FAST, seed=1)
+        client = await LspClient.connect("127.0.0.1", server.port, FAST, seed=2)
+        # 30% loss in both directions on the client side of the seam
+        client.endpoint.set_write_drop_rate(0.3)
+        client.endpoint.set_read_drop_rate(0.3)
+        n = 40
+        for i in range(n):
+            client.write(i.to_bytes(4, "big"))
+        got = []
+        for _ in range(n):
+            _, payload = await server.read()
+            assert payload is not None
+            got.append(int.from_bytes(payload, "big"))
+        assert got == list(range(n))  # exactly once, in order
+        # and the reverse direction
+        for i in range(n):
+            server.write(client.conn_id, i.to_bytes(4, "big"))
+        got = [int.from_bytes(await client.read(), "big") for _ in range(n)]
+        assert got == list(range(n))
+        await client.close()
+        await server.close()
+
+    run(scenario(), timeout=60.0)
+
+
+def test_window_limits_in_flight_frames():
+    async def scenario():
+        params = Params(epoch_limit=10, epoch_millis=40, window_size=3)
+        server = await LspServer.create(params=params)
+        client = await LspClient.connect("127.0.0.1", server.port, params)
+        # black-hole everything the client sends post-connect: acks never come
+        client.endpoint.set_write_drop_rate(1.0)
+        for i in range(10):
+            client.write(bytes([i]))
+        await asyncio.sleep(4 * params.epoch_seconds)
+        assert client._conn.in_flight == 3  # window_size caps unacked sends
+        # heal the link: everything must flow, in order
+        client.endpoint.set_write_drop_rate(0.0)
+        got = []
+        for _ in range(10):
+            _, payload = await server.read()
+            got.append(payload[0])
+        assert got == list(range(10))
+        await client.close()
+        await server.close()
+
+    run(scenario())
+
+
+def test_corrupt_datagrams_are_ignored():
+    async def scenario():
+        server = await LspServer.create(params=FAST)
+        client = await LspClient.connect("127.0.0.1", server.port, FAST)
+        # spray garbage at the server's port from a raw socket
+        loop = asyncio.get_running_loop()
+        transport, _ = await loop.create_datagram_endpoint(
+            asyncio.DatagramProtocol, local_addr=("127.0.0.1", 0)
+        )
+        for junk in (b"", b"\x00", b"garbage" * 50, encode(Frame(MsgType.DATA, 99, 5, b"x"))[:-2]):
+            transport.sendto(junk, ("127.0.0.1", server.port))
+        transport.close()
+        client.write(b"still works")
+        conn_id, payload = await server.read()
+        assert payload == b"still works"
+        await client.close()
+        await server.close()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# liveness
+# ---------------------------------------------------------------------------
+
+def test_client_detects_dead_server():
+    async def scenario():
+        server = await LspServer.create(params=FAST)
+        client = await LspClient.connect("127.0.0.1", server.port, FAST)
+        client.write(b"ping")
+        await server.read()
+        # server dies silently (no close handshake exists — like a crash)
+        await server.close()
+        with pytest.raises(LspConnectionLost):
+            while True:
+                await asyncio.wait_for(client.read(), timeout=5.0)
+        assert client.is_lost
+        await client.close()
+
+    run(scenario())
+
+
+def test_server_detects_dead_client_and_reports_loss_event():
+    async def scenario():
+        server = await LspServer.create(params=FAST)
+        client = await LspClient.connect("127.0.0.1", server.port, FAST)
+        client.write(b"hello")
+        conn_id, payload = await server.read()
+        assert payload == b"hello"
+        client.endpoint.close()  # client process "crashes"
+        lost_id, lost_payload = await server.read()
+        assert (lost_id, lost_payload) == (conn_id, None)
+        assert conn_id not in server.conn_ids
+        await server.close()
+
+    run(scenario())
+
+
+def test_heartbeats_keep_idle_connection_alive():
+    async def scenario():
+        server = await LspServer.create(params=FAST)
+        client = await LspClient.connect("127.0.0.1", server.port, FAST)
+        # idle for well past epoch_limit epochs — heartbeats must keep it up
+        await asyncio.sleep(3 * FAST.epoch_limit * FAST.epoch_seconds)
+        assert not client.is_lost
+        client.write(b"alive")
+        conn_id, payload = await server.read()
+        assert payload == b"alive"
+        await client.close()
+        await server.close()
+
+    run(scenario())
+
+
+def test_connect_to_nothing_raises():
+    async def scenario():
+        params = Params(epoch_limit=3, epoch_millis=40)
+        with pytest.raises(LspConnectError):
+            await LspClient.connect("127.0.0.1", 1, params)  # port 1: nobody home
+
+    run(scenario())
+
+
+def test_write_after_loss_raises():
+    async def scenario():
+        server = await LspServer.create(params=FAST)
+        client = await LspClient.connect("127.0.0.1", server.port, FAST)
+        await server.close()
+        with pytest.raises(LspConnectionLost):
+            while True:
+                await asyncio.wait_for(client.read(), timeout=5.0)
+        with pytest.raises(LspConnectionLost):
+            client.write(b"too late")
+        await client.close()
+
+    run(scenario())
+
+def test_close_drains_pending_writes():
+    async def scenario():
+        server = await LspServer.create(params=FAST, seed=3)
+        client = await LspClient.connect("127.0.0.1", server.port, FAST, seed=4)
+        client.endpoint.set_write_drop_rate(0.4)  # force retransmission work
+        n = 15
+        for i in range(n):
+            client.write(bytes([i]))
+        await client.close()  # must not return until data is acked (or timeout)
+        got = []
+        for _ in range(n):
+            _, payload = await server.read()
+            assert payload is not None
+            got.append(payload[0])
+        assert got == list(range(n))
+        await server.close()
+
+    run(scenario(), timeout=60.0)
+
+
+def test_server_close_conn_drains_in_flight_data():
+    async def scenario():
+        params = Params(epoch_limit=8, epoch_millis=40, window_size=1)
+        server = await LspServer.create(params=params, seed=5)
+        client = await LspClient.connect("127.0.0.1", server.port, params, seed=6)
+        client.write(b"hi")
+        conn_id, _ = await server.read()
+        server.endpoint.set_write_drop_rate(0.4)  # force retransmission work
+        for i in range(5):
+            server.write(conn_id, bytes([i]))
+        server.close_conn(conn_id)  # must keep retransmitting until drained
+        got = [(await client.read())[0] for _ in range(5)]
+        assert got == list(range(5))
+        await client.close()
+        await server.close()
+
+    run(scenario(), timeout=60.0)
+
+
+def test_client_read_after_graceful_close_raises():
+    async def scenario():
+        server = await LspServer.create(params=FAST)
+        client = await LspClient.connect("127.0.0.1", server.port, FAST)
+        await client.close()
+        with pytest.raises(LspConnectionLost):
+            await asyncio.wait_for(client.read(), timeout=5.0)
+        await server.close()
+
+    run(scenario())

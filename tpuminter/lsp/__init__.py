@@ -1,0 +1,52 @@
+"""LSP — a reliable, ordered, connection-oriented message protocol over UDP.
+
+Capability-equivalent rebuild of the reference's Live Sequence Protocol
+layer (≙ reference ``lsp/`` + ``lspnet/``, expected paths per SURVEY.md
+§1-2; mount empty per §0): sliding-window send with epoch-based
+retransmission and exponential backoff, in-order delivery, heartbeats,
+``epoch_limit``-silent-epochs connection-loss detection, and a transport
+seam (:class:`~tpuminter.lsp.transport.UdpEndpoint`) whose read/write drop
+rates tests control for deterministic fault injection (≙ ``lspnet``'s
+``SetReadDropPercent``/``SetWriteDropPercent``).
+
+Built on asyncio; a single event loop owns all timers and sockets, so the
+state machines need no locks (≙ the reference's goroutine-per-connection +
+channels design, re-derived idiomatically for Python).
+"""
+
+from tpuminter.lsp.client import LspClient
+from tpuminter.lsp.message import Frame, MsgType, decode, encode
+from tpuminter.lsp.params import Params
+from tpuminter.lsp.server import LspServer
+from tpuminter.lsp.transport import UdpEndpoint
+
+
+class LspError(Exception):
+    """Base class for LSP errors."""
+
+
+class LspConnectionLost(LspError):
+    """The peer was declared dead (epoch_limit silent epochs) or closed."""
+
+    def __init__(self, conn_id: int, reason: str = "connection lost"):
+        super().__init__(f"conn {conn_id}: {reason}")
+        self.conn_id = conn_id
+
+
+class LspConnectError(LspError):
+    """The initial connect handshake never completed."""
+
+
+__all__ = [
+    "Frame",
+    "MsgType",
+    "Params",
+    "UdpEndpoint",
+    "LspClient",
+    "LspServer",
+    "LspError",
+    "LspConnectionLost",
+    "LspConnectError",
+    "encode",
+    "decode",
+]

@@ -1,0 +1,173 @@
+"""LSP client (≙ reference ``lsp/client_impl.go``, SURVEY.md §2 #4).
+
+Connect handshake with per-epoch retransmission, then a single
+:class:`~tpuminter.lsp.connection.ConnState` drives the reliable stream.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional, Tuple, Union
+
+import tpuminter.lsp as lsp
+from tpuminter.lsp.connection import ConnState
+from tpuminter.lsp.message import Frame, MsgType, decode, encode
+from tpuminter.lsp.params import Params
+from tpuminter.lsp.transport import UdpEndpoint
+
+_LOST = object()  # sentinel in the receive queue
+
+
+class LspClient:
+    """Reliable connection to an :class:`~tpuminter.lsp.server.LspServer`.
+
+    Use :meth:`connect` to construct. ``read`` blocks for the next in-order
+    payload and raises :class:`~tpuminter.lsp.LspConnectionLost` once the
+    server is declared dead (buffered payloads are delivered first).
+    """
+
+    def __init__(self) -> None:
+        self._endpoint: Optional[UdpEndpoint] = None
+        self._server_addr: Tuple[str, int] = ("", 0)
+        self._params = Params()
+        self._conn: Optional[ConnState] = None
+        self._recv: "asyncio.Queue[Union[bytes, object]]" = asyncio.Queue()
+        self._connect_waiter: Optional[asyncio.Future] = None
+        self._epoch_task: Optional[asyncio.Task] = None
+        self._lost_reason: Optional[str] = None
+
+    # -- construction ----------------------------------------------------
+
+    @classmethod
+    async def connect(
+        cls,
+        host: str,
+        port: int,
+        params: Optional[Params] = None,
+        *,
+        seed: Optional[int] = None,
+    ) -> "LspClient":
+        """Dial the server; raises LspConnectError after epoch_limit epochs."""
+        self = cls()
+        self._params = params or Params()
+        self._server_addr = (host, port)
+        self._endpoint = await UdpEndpoint.create(self._on_datagram, seed=seed)
+        loop = asyncio.get_running_loop()
+        self._connect_waiter = loop.create_future()
+        connect_frame = encode(Frame(MsgType.CONNECT, 0, 0))
+        for _ in range(self._params.epoch_limit):
+            self._endpoint.send(connect_frame, self._server_addr)
+            try:
+                conn_id = await asyncio.wait_for(
+                    asyncio.shield(self._connect_waiter),
+                    timeout=self._params.epoch_seconds,
+                )
+                break
+            except asyncio.TimeoutError:
+                continue
+        else:
+            self._endpoint.close()
+            raise lsp.LspConnectError(
+                f"no connect-ack from {host}:{port} after "
+                f"{self._params.epoch_limit} epochs"
+            )
+        self._conn = ConnState(
+            conn_id,
+            self._params,
+            send_frame=self._send_frame,
+            deliver=self._recv.put_nowait,
+            on_lost=self._handle_lost,
+        )
+        self._epoch_task = asyncio.ensure_future(self._epoch_loop())
+        return self
+
+    # -- wiring ----------------------------------------------------------
+
+    def _send_frame(self, frame: Frame) -> None:
+        assert self._endpoint is not None
+        self._endpoint.send(encode(frame), self._server_addr)
+
+    def _on_datagram(self, data: bytes, addr: Tuple[str, int]) -> None:
+        frame = decode(data)
+        if frame is None:
+            return
+        if self._conn is None:
+            # handshake phase: the connect-ack is ACK seq 0 carrying our id
+            if (
+                frame.type == MsgType.ACK
+                and frame.seq == 0
+                and self._connect_waiter is not None
+                and not self._connect_waiter.done()
+            ):
+                self._connect_waiter.set_result(frame.conn_id)
+            return
+        if frame.conn_id == self._conn.conn_id:
+            self._conn.on_frame(frame)
+
+    def _handle_lost(self, reason: str) -> None:
+        self._lost_reason = reason
+        self._recv.put_nowait(_LOST)
+
+    async def _epoch_loop(self) -> None:
+        while self._conn is not None and not self._conn.closed_event.is_set():
+            await asyncio.sleep(self._params.epoch_seconds)
+            self._conn.on_epoch()
+
+    # -- public API ------------------------------------------------------
+
+    @property
+    def conn_id(self) -> int:
+        assert self._conn is not None
+        return self._conn.conn_id
+
+    @property
+    def is_lost(self) -> bool:
+        return self._conn is not None and self._conn.lost
+
+    def write(self, payload: bytes) -> None:
+        """Queue a payload for reliable in-order delivery."""
+        if self._conn is None or self._conn.lost:
+            raise lsp.LspConnectionLost(
+                self.conn_id if self._conn else -1,
+                self._lost_reason or "not connected",
+            )
+        self._conn.write(payload)
+
+    async def read(self) -> bytes:
+        """Next in-order payload from the server."""
+        item = await self._recv.get()
+        if item is _LOST:
+            self._recv.put_nowait(_LOST)  # subsequent reads keep failing
+            raise lsp.LspConnectionLost(
+                self.conn_id, self._lost_reason or "connection lost"
+            )
+        return item  # type: ignore[return-value]
+
+    async def close(self, drain_timeout: Optional[float] = None) -> None:
+        """Graceful close: block until pending writes are acked (≙ reference
+        ``Close`` semantics). Loss detection unblocks the drain, so a dead
+        peer can't hang us; ``drain_timeout`` optionally bounds the wait."""
+        if self._conn is not None:
+            self._conn.suppress_loss_event = True
+            self._conn.close()
+            try:
+                await asyncio.wait_for(
+                    self._conn.closed_event.wait(), drain_timeout
+                )
+            except asyncio.TimeoutError:
+                pass
+            if self._lost_reason is None:
+                self._lost_reason = "closed locally"
+            self._recv.put_nowait(_LOST)  # unblock readers racing the close
+        if self._epoch_task is not None:
+            self._epoch_task.cancel()
+        if self._endpoint is not None:
+            self._endpoint.close()
+
+    # -- test / fault-injection seam ------------------------------------
+
+    @property
+    def endpoint(self) -> UdpEndpoint:
+        """The transport seam (≙ lspnet), exposed for fault injection."""
+        assert self._endpoint is not None
+        return self._endpoint

@@ -1,0 +1,179 @@
+"""Per-connection sliding-window state machine, shared by client and server.
+
+≙ the send/receive/epoch logic of reference ``lsp/client_impl.go`` and
+``lsp/server_impl.go`` (SURVEY.md §2 #4-5, §3.4-3.5), factored once: both
+ends of an LSP connection run the identical machine — sliding-window send
+with per-frame retransmit backoff, in-order buffered delivery, heartbeat
+on idle epochs, and loss after ``epoch_limit`` silent epochs.
+
+Runs entirely on the asyncio event-loop thread; no locks (the asyncio
+re-derivation of the reference's event-loop goroutine + channels).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import OrderedDict, deque
+from typing import Callable, Deque, Dict, Optional
+
+from tpuminter.lsp.message import Frame, MsgType
+from tpuminter.lsp.params import Params
+
+
+class _Pending:
+    __slots__ = ("frame", "epochs_waited", "backoff")
+
+    def __init__(self, frame: Frame):
+        self.frame = frame
+        self.epochs_waited = 0
+        self.backoff = 0  # epochs to wait before next retransmit
+
+
+class ConnState:
+    """One reliable connection (either end).
+
+    ``send_frame`` transmits a frame toward the peer; ``deliver`` receives
+    each in-order payload; ``on_lost`` fires exactly once if the peer is
+    declared dead before a graceful close completes.
+    """
+
+    def __init__(
+        self,
+        conn_id: int,
+        params: Params,
+        send_frame: Callable[[Frame], None],
+        deliver: Callable[[bytes], None],
+        on_lost: Callable[[str], None],
+    ):
+        self.conn_id = conn_id
+        self.params = params
+        self._send_frame_raw = send_frame
+        self._deliver = deliver
+        self._on_lost = on_lost
+
+        # send side
+        self._next_seq = 1
+        self._unacked: "OrderedDict[int, _Pending]" = OrderedDict()
+        self._pending: Deque[bytes] = deque()
+
+        # receive side
+        self._expected = 1
+        self._ooo: Dict[int, bytes] = {}
+
+        # liveness
+        self._silent_epochs = 0
+        self._received_this_epoch = False
+        self._sent_this_epoch = False
+
+        self.lost = False
+        self.closing = False
+        #: When true, a loss during close/teardown emits no loss event
+        #: (set by the owner when *it* initiated the close).
+        self.suppress_loss_event = False
+        self.closed_event = asyncio.Event()
+
+    # -- helpers ---------------------------------------------------------
+
+    def _send(self, frame: Frame) -> None:
+        self._sent_this_epoch = True
+        self._send_frame_raw(frame)
+
+    def _window_open(self) -> bool:
+        oldest = next(iter(self._unacked)) if self._unacked else self._next_seq
+        return (
+            len(self._unacked) < self.params.max_unacked_messages
+            and self._next_seq < oldest + self.params.window_size
+        )
+
+    def _pump_pending(self) -> None:
+        while self._pending and self._window_open():
+            self._send_data(self._pending.popleft())
+
+    def _send_data(self, payload: bytes) -> None:
+        frame = Frame(MsgType.DATA, self.conn_id, self._next_seq, payload)
+        self._next_seq += 1
+        self._unacked[frame.seq] = _Pending(frame)
+        self._send(frame)
+
+    def _finish_close_if_drained(self) -> None:
+        if self.closing and not self._unacked and not self._pending:
+            self.closed_event.set()
+
+    # -- public API ------------------------------------------------------
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._unacked)
+
+    def write(self, payload: bytes) -> None:
+        if self.lost or self.closing:
+            raise ConnectionError(f"conn {self.conn_id} is closed or lost")
+        if self._window_open():
+            self._send_data(payload)
+        else:
+            self._pending.append(payload)
+
+    def on_frame(self, frame: Frame) -> None:
+        """Handle a decoded frame from the peer."""
+        if self.lost:
+            return
+        self._received_this_epoch = True
+        self._silent_epochs = 0
+        if frame.type == MsgType.DATA:
+            # Always ack — duplicates mean our previous ack was lost.
+            self._send(Frame(MsgType.ACK, self.conn_id, frame.seq))
+            if frame.seq >= self._expected and frame.seq not in self._ooo:
+                self._ooo[frame.seq] = frame.payload
+                while self._expected in self._ooo:
+                    self._deliver(self._ooo.pop(self._expected))
+                    self._expected += 1
+        elif frame.type == MsgType.ACK:
+            if frame.seq == 0:
+                return  # heartbeat: liveness already noted above
+            if self._unacked.pop(frame.seq, None) is not None:
+                self._pump_pending()
+                self._finish_close_if_drained()
+
+    def on_epoch(self) -> None:
+        """One epoch tick: liveness, retransmits, heartbeat (SURVEY.md §3.5)."""
+        if self.lost or self.closed_event.is_set():
+            return
+        # liveness
+        if self._received_this_epoch:
+            self._silent_epochs = 0
+        else:
+            self._silent_epochs += 1
+            if self._silent_epochs >= self.params.epoch_limit:
+                self.declare_lost(
+                    f"no traffic for {self._silent_epochs} epochs"
+                )
+                return
+        self._received_this_epoch = False
+        # retransmit with exponential backoff, capped at max_backoff_interval
+        for pending in self._unacked.values():
+            pending.epochs_waited += 1
+            if pending.epochs_waited > pending.backoff:
+                self._send(pending.frame)
+                pending.epochs_waited = 0
+                pending.backoff = min(
+                    max(1, pending.backoff * 2), self.params.max_backoff_interval
+                ) if self.params.max_backoff_interval > 0 else 0
+        # heartbeat so an idle connection stays visibly alive
+        if not self._sent_this_epoch:
+            self._send(Frame(MsgType.ACK, self.conn_id, 0))
+        self._sent_this_epoch = False
+
+    def close(self) -> None:
+        """Graceful close: stop accepting writes, drain in-flight data."""
+        self.closing = True
+        self._finish_close_if_drained()
+
+    def declare_lost(self, reason: str) -> None:
+        if self.lost:
+            return
+        self.lost = True
+        self._unacked.clear()
+        self._pending.clear()
+        self.closed_event.set()
+        if not self.suppress_loss_event:
+            self._on_lost(reason)

@@ -1,0 +1,72 @@
+"""LSP wire frames (≙ reference ``lsp/message.go``, SURVEY.md §2 #2).
+
+The reference JSON-marshals its messages; we use a fixed binary header —
+the idiomatic choice for a framework wire format — with a CRC32 integrity
+checksum (the reference's post-2017 vintages carry ``Size``/``Checksum``
+fields for the same purpose; SURVEY.md marks this [U], a free choice).
+
+Layout (little-endian):  type:u8 ‖ conn_id:u32 ‖ seq:u32 ‖ size:u16 ‖
+crc32:u32 ‖ payload[size].  A frame that fails to parse or checksum is
+*dropped*, exactly like a lost datagram — corruption and loss are the
+same failure mode to the layers above.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from dataclasses import dataclass
+from enum import IntEnum
+from typing import Optional
+
+_HEADER = struct.Struct("<BIIHI")
+
+#: Max payload carried in one frame. Kept under typical MTU so a frame is
+#: one datagram; the roles layer chunks larger app messages if needed.
+MAX_PAYLOAD = 1400
+
+
+class MsgType(IntEnum):
+    CONNECT = 0  # client → server, seq 0, empty payload
+    DATA = 1     # either direction, seq ≥ 1
+    ACK = 2      # acks DATA seq; seq 0 = connect-ack / heartbeat
+
+
+@dataclass(frozen=True)
+class Frame:
+    type: MsgType
+    conn_id: int
+    seq: int
+    payload: bytes = b""
+
+
+def _crc(type_: int, conn_id: int, seq: int, payload: bytes) -> int:
+    head = struct.pack("<BIIH", type_, conn_id, seq, len(payload))
+    return zlib.crc32(payload, zlib.crc32(head))
+
+
+def encode(frame: Frame) -> bytes:
+    if len(frame.payload) > MAX_PAYLOAD:
+        raise ValueError(f"payload too large: {len(frame.payload)} > {MAX_PAYLOAD}")
+    crc = _crc(frame.type, frame.conn_id, frame.seq, frame.payload)
+    return (
+        _HEADER.pack(frame.type, frame.conn_id, frame.seq, len(frame.payload), crc)
+        + frame.payload
+    )
+
+
+def decode(data: bytes) -> Optional[Frame]:
+    """Parse a datagram; return None for anything malformed (≙ drop)."""
+    if len(data) < _HEADER.size:
+        return None
+    type_, conn_id, seq, size, crc = _HEADER.unpack_from(data)
+    payload = data[_HEADER.size : _HEADER.size + size]
+    if len(payload) != size:
+        return None  # truncated
+    if crc != _crc(type_, conn_id, seq, payload):
+        return None  # corrupt
+    try:
+        mtype = MsgType(type_)
+    except ValueError:
+        return None  # unknown type
+    return Frame(mtype, conn_id, seq, payload)

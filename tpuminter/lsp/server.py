@@ -1,0 +1,172 @@
+"""LSP server (≙ reference ``lsp/server_impl.go``, SURVEY.md §2 #5).
+
+One UDP socket demuxes all clients by source address; each gets a conn_id
+and its own :class:`~tpuminter.lsp.connection.ConnState`. ``read`` yields
+``(conn_id, payload)`` events in arrival order, with ``(conn_id, None)``
+signalling that the connection was declared lost — the event the
+coordinator's failure recovery hangs off (SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional, Tuple
+
+from tpuminter.lsp.connection import ConnState
+from tpuminter.lsp.message import Frame, MsgType, decode, encode
+from tpuminter.lsp.params import Params
+from tpuminter.lsp.transport import Addr, UdpEndpoint
+
+
+class LspServer:
+    """Reliable multi-client listener. Use :meth:`create` to construct."""
+
+    def __init__(self) -> None:
+        self._endpoint: Optional[UdpEndpoint] = None
+        self._params = Params()
+        self._by_addr: Dict[Addr, ConnState] = {}
+        self._by_id: Dict[int, ConnState] = {}
+        self._addr_of: Dict[int, Addr] = {}
+        self._next_conn_id = 1
+        self._events: "asyncio.Queue[Tuple[int, Optional[bytes]]]" = asyncio.Queue()
+        self._epoch_task: Optional[asyncio.Task] = None
+
+    @classmethod
+    async def create(
+        cls,
+        port: int = 0,
+        params: Optional[Params] = None,
+        *,
+        host: str = "127.0.0.1",
+        seed: Optional[int] = None,
+    ) -> "LspServer":
+        self = cls()
+        self._params = params or Params()
+        self._endpoint = await UdpEndpoint.create(
+            self._on_datagram, local_addr=(host, port), seed=seed
+        )
+        self._epoch_task = asyncio.ensure_future(self._epoch_loop())
+        return self
+
+    # -- wiring ----------------------------------------------------------
+
+    def _on_datagram(self, data: bytes, addr: Addr) -> None:
+        frame = decode(data)
+        if frame is None:
+            return
+        conn = self._by_addr.get(addr)
+        if frame.type == MsgType.CONNECT:
+            if conn is None:
+                conn = self._new_conn(addr)
+            # (re-)ack the handshake; duplicate CONNECTs mean our ack was lost
+            self._send_to(addr, Frame(MsgType.ACK, conn.conn_id, 0))
+            conn.on_frame(frame)
+        elif conn is not None and frame.conn_id == conn.conn_id:
+            conn.on_frame(frame)
+        # frames for unknown/stale connections are dropped
+
+    def _new_conn(self, addr: Addr) -> ConnState:
+        conn_id = self._next_conn_id
+        self._next_conn_id += 1
+        conn = ConnState(
+            conn_id,
+            self._params,
+            send_frame=lambda f, a=addr: self._send_to(a, f),
+            deliver=lambda payload, cid=conn_id: self._events.put_nowait(
+                (cid, payload)
+            ),
+            on_lost=lambda reason, cid=conn_id: self._handle_lost(cid),
+        )
+        self._by_addr[addr] = conn
+        self._by_id[conn_id] = conn
+        self._addr_of[conn_id] = addr
+        return conn
+
+    def _send_to(self, addr: Addr, frame: Frame) -> None:
+        assert self._endpoint is not None
+        self._endpoint.send(encode(frame), addr)
+
+    def _handle_lost(self, conn_id: int) -> None:
+        self._events.put_nowait((conn_id, None))
+        self._forget(conn_id)
+
+    def _forget(self, conn_id: int) -> None:
+        addr = self._addr_of.pop(conn_id, None)
+        if addr is not None:
+            self._by_addr.pop(addr, None)
+        self._by_id.pop(conn_id, None)
+
+    async def _epoch_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self._params.epoch_seconds)
+            for conn in list(self._by_id.values()):
+                conn.on_epoch()
+
+    # -- public API ------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        assert self._endpoint is not None
+        return self._endpoint.local_addr[1]
+
+    @property
+    def conn_ids(self) -> Tuple[int, ...]:
+        return tuple(self._by_id)
+
+    async def read(self) -> Tuple[int, Optional[bytes]]:
+        """Next event from any client: ``(conn_id, payload)``, where a
+        ``None`` payload means the connection was declared lost."""
+        return await self._events.get()
+
+    def write(self, conn_id: int, payload: bytes) -> None:
+        conn = self._by_id.get(conn_id)
+        if conn is None:
+            raise ConnectionError(f"conn {conn_id} does not exist (or was lost)")
+        conn.write(payload)
+
+    def close_conn(self, conn_id: int) -> None:
+        """Close one client connection: reject further writes, keep the
+        connection ticking until in-flight data drains (or the peer is
+        declared dead), then forget it. No loss event is emitted for a
+        connection *we* closed."""
+        conn = self._by_id.get(conn_id)
+        if conn is None:
+            return
+        conn.suppress_loss_event = True
+        conn.close()
+
+        async def _reap() -> None:
+            await conn.closed_event.wait()
+            self._forget(conn_id)
+
+        if conn.closed_event.is_set():
+            self._forget(conn_id)
+        else:
+            asyncio.ensure_future(_reap())
+
+    async def close(self, drain_timeout: Optional[float] = None) -> None:
+        """Close all connections, draining in-flight data first (bounded by
+        ``drain_timeout``; a dead peer unblocks via loss detection)."""
+        conns = list(self._by_id.values())
+        for conn_id in list(self._by_id):
+            self.close_conn(conn_id)
+        if conns:
+            try:
+                await asyncio.wait_for(
+                    asyncio.gather(*(c.closed_event.wait() for c in conns)),
+                    drain_timeout,
+                )
+            except asyncio.TimeoutError:
+                pass
+        if self._epoch_task is not None:
+            self._epoch_task.cancel()
+        if self._endpoint is not None:
+            self._endpoint.close()
+
+    # -- test / fault-injection seam ------------------------------------
+
+    @property
+    def endpoint(self) -> UdpEndpoint:
+        """The transport seam (≙ lspnet), exposed for fault injection."""
+        assert self._endpoint is not None
+        return self._endpoint

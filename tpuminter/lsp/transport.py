@@ -1,0 +1,101 @@
+"""UDP transport seam with deterministic fault injection.
+
+≙ reference ``lspnet/`` (SURVEY.md §2 #1): the *only* network path for the
+LSP layer, wrapping the raw socket and exposing read/write drop-rate
+setters so tests simulate lossy networks on localhost without a real lossy
+link — SURVEY.md §4's "own the transport seam, inject faults at it".
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Awaitable, Callable, Optional, Tuple, Union
+
+Addr = Tuple[str, int]
+DatagramHandler = Callable[[bytes, Addr], Union[None, Awaitable[None]]]
+
+
+class UdpEndpoint(asyncio.DatagramProtocol):
+    """A UDP socket with injectable packet loss.
+
+    ``write_drop_rate`` / ``read_drop_rate`` ∈ [0, 1] drop outgoing /
+    incoming datagrams using a seeded PRNG, so loss patterns are
+    reproducible in CI (≙ ``lspnet.SetWriteDropPercent`` /
+    ``SetReadDropPercent``).
+    """
+
+    def __init__(self, on_datagram: DatagramHandler, seed: Optional[int] = None):
+        self._on_datagram = on_datagram
+        self._rng = random.Random(seed)
+        self.write_drop_rate = 0.0
+        self.read_drop_rate = 0.0
+        self._transport: Optional[asyncio.DatagramTransport] = None
+        self._closed = asyncio.get_running_loop().create_future()
+        #: Counters for tests/metrics.
+        self.sent = 0
+        self.received = 0
+        self.dropped_out = 0
+        self.dropped_in = 0
+
+    @classmethod
+    async def create(
+        cls,
+        on_datagram: DatagramHandler,
+        local_addr: Optional[Addr] = None,
+        seed: Optional[int] = None,
+    ) -> "UdpEndpoint":
+        loop = asyncio.get_running_loop()
+        _, protocol = await loop.create_datagram_endpoint(
+            lambda: cls(on_datagram, seed=seed),
+            local_addr=local_addr or ("0.0.0.0", 0),
+        )
+        return protocol
+
+    # -- asyncio.DatagramProtocol ----------------------------------------
+
+    def connection_made(self, transport: asyncio.BaseTransport) -> None:
+        self._transport = transport  # type: ignore[assignment]
+
+    def datagram_received(self, data: bytes, addr: Addr) -> None:
+        if self.read_drop_rate > 0 and self._rng.random() < self.read_drop_rate:
+            self.dropped_in += 1
+            return
+        self.received += 1
+        result = self._on_datagram(data, addr)
+        if asyncio.iscoroutine(result):
+            asyncio.ensure_future(result)
+
+    def connection_lost(self, exc: Optional[Exception]) -> None:
+        if not self._closed.done():
+            self._closed.set_result(None)
+
+    # -- public API ------------------------------------------------------
+
+    @property
+    def local_addr(self) -> Addr:
+        assert self._transport is not None
+        return self._transport.get_extra_info("sockname")[:2]
+
+    def send(self, data: bytes, addr: Addr) -> None:
+        """Send one datagram (silently dropped at ``write_drop_rate``)."""
+        if self._transport is None or self._transport.is_closing():
+            return
+        if self.write_drop_rate > 0 and self._rng.random() < self.write_drop_rate:
+            self.dropped_out += 1
+            return
+        self.sent += 1
+        self._transport.sendto(data, addr)
+
+    def set_write_drop_rate(self, rate: float) -> None:
+        self.write_drop_rate = rate
+
+    def set_read_drop_rate(self, rate: float) -> None:
+        self.read_drop_rate = rate
+
+    def close(self) -> None:
+        if self._transport is not None and not self._transport.is_closing():
+            self._transport.close()
+
+    async def wait_closed(self) -> None:
+        await self._closed
