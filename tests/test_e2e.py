@@ -1,0 +1,337 @@
+"""End-to-end role tests (SURVEY.md §4 "Part B" strategy): coordinator +
+miners + clients on localhost in one process; correctness is asserted
+against brute-force ground truth; worker death mid-job must not lose or
+corrupt results ("results must survive worker death")."""
+
+import asyncio
+import struct
+
+import pytest
+
+from tpuminter import chain
+from tpuminter.client import submit
+from tpuminter.coordinator import Coordinator
+from tpuminter.lsp import Params
+from tpuminter.protocol import PowMode, Request
+from tpuminter.worker import CpuMiner, run_miner
+
+FAST = Params(
+    epoch_limit=5,
+    epoch_millis=50,
+    window_size=32,
+    max_backoff_interval=2,
+    max_unacked_messages=32,
+)
+
+
+def run(coro, timeout=60.0):
+    async def wrapped():
+        return await asyncio.wait_for(coro, timeout)
+
+    return asyncio.run(wrapped())
+
+
+def brute_min(data: bytes, lower: int, upper: int):
+    best = min((chain.toy_hash(data, n), n) for n in range(lower, upper + 1))
+    return best  # (hash, nonce)
+
+
+class Cluster:
+    """Coordinator + miner tasks wired up on localhost."""
+
+    def __init__(self, coordinator):
+        self.coord = coordinator
+        self.serve_task = asyncio.ensure_future(coordinator.serve())
+        self.miner_tasks = []
+
+    @classmethod
+    async def create(cls, n_miners=1, chunk_size=4096, miner_factory=CpuMiner):
+        coord = await Coordinator.create(params=FAST, chunk_size=chunk_size)
+        self = cls(coord)
+        for _ in range(n_miners):
+            await self.add_miner(miner_factory())
+        return self
+
+    async def add_miner(self, miner):
+        task = asyncio.ensure_future(
+            run_miner("127.0.0.1", self.coord.port, miner, params=FAST)
+        )
+        self.miner_tasks.append(task)
+        # let the Join land before work is submitted
+        await asyncio.sleep(0.05)
+        return task
+
+    async def kill_miner(self, index):
+        """Hard-kill a miner: cancel its task; no goodbye to the server.
+
+        The coordinator only learns of the death through epoch-based
+        liveness, exactly like a crashed reference miner process.
+        """
+        task = self.miner_tasks[index]
+        task.cancel()
+        try:
+            await task
+        except (asyncio.CancelledError, Exception):
+            pass
+
+    async def close(self):
+        for t in self.miner_tasks:
+            t.cancel()
+        self.serve_task.cancel()
+        await asyncio.gather(*self.miner_tasks, self.serve_task, return_exceptions=True)
+        await self.coord.close()
+
+
+# ---------------------------------------------------------------------------
+# toy (MIN) mode — reference user story
+# ---------------------------------------------------------------------------
+
+def test_single_miner_min_mode_matches_brute_force():
+    async def scenario():
+        cluster = await Cluster.create(n_miners=1)
+        try:
+            req = Request(job_id=7, mode=PowMode.MIN, lower=0, upper=9999,
+                          data=b"hello bitcoin")
+            result = await submit("127.0.0.1", cluster.coord.port, req, params=FAST)
+            want_hash, want_nonce = brute_min(b"hello bitcoin", 0, 9999)
+            assert result.job_id == 7
+            assert (result.hash_value, result.nonce) == (want_hash, want_nonce)
+            assert result.found
+        finally:
+            await cluster.close()
+
+    run(scenario())
+
+
+def test_three_miners_split_one_job():
+    async def scenario():
+        cluster = await Cluster.create(n_miners=3, chunk_size=1024)
+        try:
+            req = Request(job_id=1, mode=PowMode.MIN, lower=0, upper=20_000,
+                          data=b"parallel")
+            result = await submit("127.0.0.1", cluster.coord.port, req, params=FAST)
+            assert (result.hash_value, result.nonce) == brute_min(b"parallel", 0, 20_000)
+            # the job really was split across chunks
+            assert cluster.coord.stats["hashes"] == 20_001
+        finally:
+            await cluster.close()
+
+    run(scenario())
+
+
+def test_concurrent_clients_round_robin():
+    async def scenario():
+        cluster = await Cluster.create(n_miners=2, chunk_size=1024)
+        try:
+            reqs = [
+                Request(job_id=i, mode=PowMode.MIN, lower=0, upper=8000,
+                        data=f"job-{i}".encode())
+                for i in range(3)
+            ]
+            results = await asyncio.gather(
+                *(submit("127.0.0.1", cluster.coord.port, r, params=FAST) for r in reqs)
+            )
+            for i, result in enumerate(results):
+                assert result.job_id == i
+                want = brute_min(f"job-{i}".encode(), 0, 8000)
+                assert (result.hash_value, result.nonce) == want
+        finally:
+            await cluster.close()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# worker death — the core recovery story
+# ---------------------------------------------------------------------------
+
+def test_miner_death_mid_job_requeues_and_completes():
+    async def scenario():
+        cluster = await Cluster.create(n_miners=2, chunk_size=1024)
+        try:
+            data = b"survive the death"
+            req = Request(job_id=1, mode=PowMode.MIN, lower=0, upper=400_000, data=data)
+            submit_task = asyncio.ensure_future(
+                submit("127.0.0.1", cluster.coord.port, req, params=FAST)
+            )
+            await asyncio.sleep(0.1)  # both miners are mid-chunk now
+            await cluster.kill_miner(0)
+            result = await submit_task
+            assert (result.hash_value, result.nonce) == brute_min(data, 0, 400_000)
+            assert cluster.coord.stats["chunks_requeued"] >= 1
+        finally:
+            await cluster.close()
+
+    run(scenario())
+
+
+def test_all_miners_die_then_new_miner_joins():
+    async def scenario():
+        cluster = await Cluster.create(n_miners=1, chunk_size=1024)
+        try:
+            data = b"late joiner saves the day"
+            req = Request(job_id=1, mode=PowMode.MIN, lower=0, upper=150_000, data=data)
+            submit_task = asyncio.ensure_future(
+                submit("127.0.0.1", cluster.coord.port, req, params=FAST)
+            )
+            await asyncio.sleep(0.1)
+            await cluster.kill_miner(0)  # now zero miners; job must stall, not die
+            await asyncio.sleep(0.5)     # past the death-detection horizon
+            assert not submit_task.done()
+            await cluster.add_miner(CpuMiner())  # elasticity: join mid-job
+            result = await submit_task
+            assert (result.hash_value, result.nonce) == brute_min(data, 0, 150_000)
+        finally:
+            await cluster.close()
+
+    run(scenario())
+
+
+def test_client_death_drops_job_and_coordinator_survives():
+    async def scenario():
+        cluster = await Cluster.create(n_miners=1, chunk_size=512)
+        try:
+            from tpuminter.lsp import LspClient
+            from tpuminter.protocol import encode_msg
+
+            doomed = await LspClient.connect("127.0.0.1", cluster.coord.port, FAST)
+            doomed.write(encode_msg(
+                Request(job_id=1, mode=PowMode.MIN, lower=0, upper=500_000,
+                        data=b"abandoned")
+            ))
+            await asyncio.sleep(0.15)
+            await doomed.close()  # client vanishes mid-job
+            # coordinator must still serve a healthy client
+            req = Request(job_id=2, mode=PowMode.MIN, lower=0, upper=2000, data=b"ok")
+            result = await submit("127.0.0.1", cluster.coord.port, req, params=FAST)
+            assert (result.hash_value, result.nonce) == brute_min(b"ok", 0, 2000)
+        finally:
+            await cluster.close()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# TARGET mode — real Bitcoin semantics (capability delta, BASELINE.json:6-8)
+# ---------------------------------------------------------------------------
+
+def test_target_mode_finds_genesis_nonce():
+    async def scenario():
+        cluster = await Cluster.create(n_miners=2, chunk_size=256)
+        try:
+            genesis_nonce = chain.GENESIS_HEADER.nonce
+            req = Request(
+                job_id=1,
+                mode=PowMode.TARGET,
+                lower=genesis_nonce - 500,
+                upper=genesis_nonce + 500,
+                header=chain.GENESIS_HEADER.pack(),
+                target=chain.bits_to_target(0x1D00FFFF),
+            )
+            result = await submit("127.0.0.1", cluster.coord.port, req, params=FAST)
+            assert result.found
+            assert result.nonce == genesis_nonce
+            digest = result.hash_value.to_bytes(32, "little")
+            assert chain.hash_to_hex(digest) == chain.GENESIS_HASH_HEX
+        finally:
+            await cluster.close()
+
+    run(scenario())
+
+
+def test_target_mode_exhausted_reports_best_effort():
+    async def scenario():
+        cluster = await Cluster.create(n_miners=1, chunk_size=256)
+        try:
+            req = Request(
+                job_id=1,
+                mode=PowMode.TARGET,
+                lower=0,
+                upper=999,  # range with no winner at genesis difficulty
+                header=chain.GENESIS_HEADER.pack(),
+                target=chain.bits_to_target(0x1D00FFFF),
+            )
+            result = await submit("127.0.0.1", cluster.coord.port, req, params=FAST)
+            assert not result.found
+            # best-effort minimum is still reported, and is reproducible
+            prefix = chain.GENESIS_HEADER.pack()[:76]
+            want = min(
+                (chain.hash_to_int(chain.dsha256(prefix + struct.pack("<I", n))), n)
+                for n in range(1000)
+            )
+            assert (result.hash_value, result.nonce) == want
+        finally:
+            await cluster.close()
+
+    run(scenario())
+
+
+def test_target_mode_early_exit_cancels_remaining_work():
+    async def scenario():
+        # easy target: ~1/16 of hashes win, so a hit lands in the first
+        # chunks and the job must finish WITHOUT sweeping the huge range.
+        cluster = await Cluster.create(n_miners=2, chunk_size=1024)
+        try:
+            easy_target = (1 << 252) - 1
+            req = Request(
+                job_id=1,
+                mode=PowMode.TARGET,
+                lower=0,
+                upper=50_000_000,  # would take minutes to sweep on CPU
+                header=chain.GENESIS_HEADER.pack(),
+                target=easy_target,
+            )
+            result = await asyncio.wait_for(
+                submit("127.0.0.1", cluster.coord.port, req, params=FAST), 20.0
+            )
+            assert result.found
+            prefix = chain.GENESIS_HEADER.pack()[:76]
+            digest = chain.dsha256(prefix + struct.pack("<I", result.nonce))
+            assert chain.hash_to_int(digest) == result.hash_value
+            assert result.hash_value <= easy_target
+            # early exit: nowhere near the full range was searched
+            assert cluster.coord.stats["hashes"] < 1_000_000
+        finally:
+            await cluster.close()
+
+    run(scenario())
+
+
+def test_cancelled_miners_are_redispatched():
+    """Regression: a Cancel that lands mid-chunk must return the miner to
+    the idle pool (a cancelled worker sends no Result, so nothing else
+    frees it). chunk_size > CpuMiner.batch so cancels interrupt mid-mine
+    — the production default geometry."""
+
+    async def scenario():
+        cluster = await Cluster.create(
+            n_miners=2, chunk_size=50_000,
+            miner_factory=lambda: CpuMiner(batch=512),
+        )
+        try:
+            easy_target = (1 << 252) - 1
+            for round_no in range(3):
+                req = Request(
+                    job_id=round_no,
+                    mode=PowMode.TARGET,
+                    lower=0,
+                    upper=10_000_000,
+                    header=chain.GENESIS_HEADER.pack(),
+                    target=easy_target,
+                )
+                result = await asyncio.wait_for(
+                    submit("127.0.0.1", cluster.coord.port, req, params=FAST), 15.0
+                )
+                assert result.found
+            # after three early-exited jobs both miners must still be
+            # usable: a MIN job that needs the whole range completes
+            req = Request(job_id=99, mode=PowMode.MIN, lower=0, upper=5000,
+                          data=b"still alive")
+            result = await asyncio.wait_for(
+                submit("127.0.0.1", cluster.coord.port, req, params=FAST), 15.0
+            )
+            assert (result.hash_value, result.nonce) == brute_min(b"still alive", 0, 5000)
+        finally:
+            await cluster.close()
+
+    run(scenario())

@@ -1,0 +1,107 @@
+"""Client role: submit one mining job, await the answer.
+
+Capability-equivalent rebuild of the reference's ``bitcoin/client/client.go``
+(SURVEY.md §2 #8, §3.1; mount empty per §0): connect, send one Request,
+block on Read, print ``Result <hash> <nonce>`` — or ``Disconnected`` if
+the coordinator is declared lost. The CLI keeps the reference's toy-mode
+shape (``<host:port> <message> <maxNonce>``) and adds a ``--header`` /
+``--bits`` TARGET mode for real block headers (BASELINE.json:7).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from tpuminter import chain
+from tpuminter.lsp import LspClient, LspConnectionLost, Params
+from tpuminter.lsp.params import FAST
+from tpuminter.protocol import PowMode, Request, Result, decode_msg, encode_msg
+
+__all__ = ["submit", "main"]
+
+log = logging.getLogger("tpuminter.client")
+
+
+async def submit(
+    host: str,
+    port: int,
+    request: Request,
+    *,
+    params: Optional[Params] = None,
+) -> Result:
+    """Connect, submit ``request``, and await its final Result.
+
+    Raises :class:`LspConnectionLost` if the coordinator dies first (the
+    caller prints ``Disconnected``, matching the reference UX).
+    """
+    client = await LspClient.connect(host, port, params or FAST)
+    try:
+        client.write(encode_msg(request))
+        while True:
+            msg = decode_msg(await client.read())
+            if isinstance(msg, Result) and msg.job_id == request.job_id:
+                return msg
+            log.warning("client: ignoring unexpected %s", type(msg).__name__)
+    finally:
+        await client.close(drain_timeout=2.0)
+
+
+def main(argv: Optional[list] = None) -> None:
+    """CLI (≙ reference ``./client <host:port> <message> <maxNonce>``)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(description="tpuminter client")
+    parser.add_argument("hostport", help="coordinator address, host:port")
+    parser.add_argument("message", nargs="?", help="toy-mode payload string")
+    parser.add_argument("max_nonce", nargs="?", type=int, help="toy-mode nonce bound")
+    parser.add_argument("--header", help="TARGET mode: 160-hex-char block header")
+    parser.add_argument("--bits", type=lambda s: int(s, 0), default=0x1D00FFFF,
+                        help="TARGET mode: compact difficulty bits (default diff-1)")
+    parser.add_argument("--max-nonce", dest="max_nonce_opt", type=int,
+                        default=0xFFFFFFFF, help="TARGET mode: nonce sweep bound")
+    args = parser.parse_args(argv)
+    host, _, port = args.hostport.rpartition(":")
+    logging.basicConfig(level=logging.WARNING)
+
+    if args.header is not None:
+        header = bytes.fromhex(args.header)
+        request = Request(
+            job_id=1,
+            mode=PowMode.TARGET,
+            lower=0,
+            upper=args.max_nonce_opt,
+            header=header,
+            target=chain.bits_to_target(args.bits),
+        )
+    elif args.message is not None and args.max_nonce is not None:
+        request = Request(
+            job_id=1,
+            mode=PowMode.MIN,
+            lower=0,
+            upper=args.max_nonce,
+            data=args.message.encode(),
+        )
+    else:
+        parser.error("need either <message> <maxNonce> or --header")
+
+    async def _run() -> None:
+        try:
+            result = await submit(host or "127.0.0.1", int(port), request)
+        except LspConnectionLost:
+            print("Disconnected")
+            return
+        if request.mode == PowMode.MIN:
+            print(f"Result {result.hash_value} {result.nonce}")
+        elif result.found:
+            digest = result.hash_value.to_bytes(32, "little")
+            print(f"Result {chain.hash_to_hex(digest)} {result.nonce}")
+        else:
+            print("Exhausted (no nonce met the target)")
+
+    asyncio.run(_run())
+
+
+if __name__ == "__main__":
+    main()

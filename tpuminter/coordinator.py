@@ -1,0 +1,366 @@
+"""Coordinator role: connection demux, work scheduler, result folder.
+
+Capability-equivalent rebuild of the reference's ``bitcoin/server/server.go``
+(SURVEY.md §2 #10, §3.3; mount empty per §0): accept clients and miners
+(distinguished by their first message — ``Join`` ⇒ miner, ``Request`` ⇒
+client), split each job's nonce range into chunks, load-balance chunks
+across idle miners, requeue a dead miner's in-flight chunk, drop a dead
+client's job, fold chunk results with min, reply when done.
+
+Scheduler design (the reference's policy is student-designed [U]; ours is
+chosen for the heterogeneous-worker north-star, BASELINE.json:5):
+
+- **Chunks are carved at dispatch time, not pre-split.** Each job keeps a
+  deque of remaining ranges; when a miner goes idle we carve
+  ``chunk_size × miner.lanes`` nonces off the next job's range. A CPU
+  worker (lanes=1) gets small chunks, a TPU worker advertising millions
+  of lanes gets pod-sized chunks — one policy serves both.
+- **Round-robin across jobs** so no client starves behind a big sweep.
+- **Early exit propagates**: the first TARGET-mode hit finishes the job,
+  replies to the client, drops its queued ranges, and ``Cancel``s the
+  job's other in-flight chunks (≙ no reference analogue; see
+  ``protocol.Cancel``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, Optional, Tuple
+
+from tpuminter.lsp import LspServer, Params
+from tpuminter.lsp.params import FAST
+from tpuminter.protocol import (
+    Cancel,
+    Join,
+    PowMode,
+    ProtocolError,
+    Request,
+    Result,
+    decode_msg,
+    encode_msg,
+)
+
+__all__ = ["Coordinator", "main"]
+
+log = logging.getLogger("tpuminter.coordinator")
+
+#: Nonces per dispatch per worker lane. CPU workers (lanes=1) get ranges
+#: a Python hot loop finishes in ~0.1 s; device workers scale this by
+#: their advertised lane count.
+DEFAULT_CHUNK_SIZE = 16_384
+
+
+@dataclass
+class _MinerState:
+    conn_id: int
+    backend: str
+    lanes: int
+    #: (chunk_id, job_id, lower, upper) currently assigned, or None if
+    #: idle. The chunk_id lets a Result be matched to the exact dispatch
+    #: it answers: after a Cancel races a completion, a stale Result must
+    #: not clobber the miner's next assignment.
+    chunk: Optional[Tuple[int, int, int, int]] = None
+
+
+@dataclass
+class _Job:
+    job_id: int                  # coordinator-internal, unique across clients
+    client_conn: int
+    client_job_id: int           # echoed back in the final Result
+    request: Request             # the client's original full-range request
+    ranges: Deque[Tuple[int, int]] = field(default_factory=deque)
+    inflight: Dict[int, Tuple[int, int]] = field(default_factory=dict)  # miner conn → range
+    best: Optional[Tuple[int, int]] = None  # (hash_value, nonce) min-fold
+    done: bool = False
+    started: float = field(default_factory=time.monotonic)
+    hashes_done: int = 0
+
+    def fold(self, hash_value: int, nonce: int) -> None:
+        if self.best is None or (hash_value, nonce) < self.best:
+            self.best = (hash_value, nonce)
+
+    @property
+    def exhausted(self) -> bool:
+        return not self.ranges and not self.inflight
+
+
+class Coordinator:
+    """The scheduler. Owns an :class:`LspServer`; drive with :meth:`serve`."""
+
+    def __init__(self, server: LspServer, *, chunk_size: int = DEFAULT_CHUNK_SIZE):
+        self._server = server
+        self._chunk_size = chunk_size
+        self._miners: Dict[int, _MinerState] = {}
+        self._clients: Dict[int, set] = {}        # client conn → its job_ids
+        self._jobs: Dict[int, _Job] = {}
+        self._rotation: Deque[int] = deque()      # job_ids with queued ranges
+        self._next_job_id = 1
+        self._next_chunk_id = 1
+        #: cumulative (hashes searched, jobs finished) — observability (§5)
+        self.stats = {"hashes": 0, "jobs_done": 0, "chunks_requeued": 0}
+
+    @classmethod
+    async def create(
+        cls,
+        port: int = 0,
+        *,
+        params: Optional[Params] = None,
+        chunk_size: int = DEFAULT_CHUNK_SIZE,
+        host: str = "127.0.0.1",
+    ) -> "Coordinator":
+        server = await LspServer.create(port, params or FAST, host=host)
+        return cls(server, chunk_size=chunk_size)
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    @property
+    def server(self) -> LspServer:
+        return self._server
+
+    # -- event loop ------------------------------------------------------
+
+    async def serve(self) -> None:
+        """Process events forever (≙ reference server main loop, §3.3)."""
+        while True:
+            conn_id, payload = await self._server.read()
+            if payload is None:
+                self._on_lost(conn_id)
+                continue
+            try:
+                msg = decode_msg(payload)
+            except ProtocolError as exc:
+                log.warning("conn %d: malformed message dropped: %s", conn_id, exc)
+                continue
+            if isinstance(msg, Join):
+                self._on_join(conn_id, msg)
+            elif isinstance(msg, Request):
+                self._on_request(conn_id, msg)
+            elif isinstance(msg, Result):
+                self._on_result(conn_id, msg)
+            else:
+                log.warning("conn %d: unexpected %s", conn_id, type(msg).__name__)
+
+    async def close(self) -> None:
+        await self._server.close(drain_timeout=2.0)
+
+    # -- membership ------------------------------------------------------
+
+    def _on_join(self, conn_id: int, msg: Join) -> None:
+        if conn_id in self._miners:
+            return  # duplicate Join: already registered
+        self._miners[conn_id] = _MinerState(conn_id, msg.backend, max(1, msg.lanes))
+        log.info("miner %d joined (backend=%s, lanes=%d)", conn_id, msg.backend, msg.lanes)
+        self._dispatch()
+
+    def _on_lost(self, conn_id: int) -> None:
+        miner = self._miners.pop(conn_id, None)
+        if miner is not None:
+            if miner.chunk is not None:
+                _, job_id, lo, hi = miner.chunk
+                job = self._jobs.get(job_id)
+                if job is not None and not job.done:
+                    job.inflight.pop(conn_id, None)
+                    job.ranges.appendleft((lo, hi))
+                    if job_id not in self._rotation:
+                        self._rotation.append(job_id)
+                    self.stats["chunks_requeued"] += 1
+                    log.info(
+                        "miner %d died; requeued [%d, %d] of job %d",
+                        conn_id, lo, hi, job_id,
+                    )
+            else:
+                log.info("idle miner %d died", conn_id)
+            self._dispatch()
+            return
+        job_ids = self._clients.pop(conn_id, None)
+        if job_ids:
+            for job_id in list(job_ids):
+                self._abandon_job(job_id)
+            log.info("client %d died; dropped jobs %s", conn_id, sorted(job_ids))
+
+    # -- job lifecycle ---------------------------------------------------
+
+    def _on_request(self, conn_id: int, msg: Request) -> None:
+        if conn_id in self._miners:
+            log.warning("miner %d sent a client Request; dropped", conn_id)
+            return
+        job_id = self._next_job_id
+        self._next_job_id += 1
+        job = _Job(
+            job_id=job_id,
+            client_conn=conn_id,
+            client_job_id=msg.job_id,
+            request=msg,
+        )
+        job.ranges.append((msg.lower, msg.upper))
+        self._jobs[job_id] = job
+        self._clients.setdefault(conn_id, set()).add(job_id)
+        self._rotation.append(job_id)
+        log.info(
+            "client %d submitted job %d: mode=%s range=[%d, %d]",
+            conn_id, job_id, msg.mode.value, msg.lower, msg.upper,
+        )
+        self._dispatch()
+
+    def _on_result(self, conn_id: int, msg: Result) -> None:
+        miner = self._miners.get(conn_id)
+        if miner is None:
+            return  # result from something that never Joined
+        if miner.chunk is None or miner.chunk[0] != msg.chunk_id:
+            # stale: answers a dispatch we already cancelled/requeued. The
+            # miner's current assignment (if any) is still being mined —
+            # leave it untouched.
+            return
+        _, job_id, lo, hi = miner.chunk
+        miner.chunk = None
+        job = self._jobs.get(job_id)
+        if job is not None and not job.done:
+            job.inflight.pop(conn_id, None)
+            searched = msg.searched if msg.searched > 0 else hi - lo + 1
+            job.hashes_done += searched
+            self.stats["hashes"] += searched
+            job.fold(msg.hash_value, msg.nonce)
+            if msg.found and job.request.mode == PowMode.TARGET:
+                self._finish_job(job, found=True)
+            elif job.exhausted:
+                found = (
+                    job.request.mode == PowMode.MIN
+                    or job.best[0] <= (job.request.target or 0)
+                )
+                self._finish_job(job, found=found)
+        self._dispatch()
+
+    def _finish_job(self, job: _Job, *, found: bool) -> None:
+        job.done = True
+        hash_value, nonce = job.best
+        try:
+            self._server.write(
+                job.client_conn,
+                encode_msg(
+                    Result(
+                        job.client_job_id, job.request.mode, nonce, hash_value,
+                        found, searched=job.hashes_done,
+                    )
+                ),
+            )
+        except ConnectionError:
+            pass  # client died between fold and reply; nothing to do
+        elapsed = time.monotonic() - job.started
+        rate = job.hashes_done / elapsed if elapsed > 0 else 0.0
+        log.info(
+            "job %d done in %.3fs: found=%s nonce=%d (%.2f MH/s across workers)",
+            job.job_id, elapsed, found, nonce, rate / 1e6,
+        )
+        self.stats["jobs_done"] += 1
+        self._retire_job(job)
+
+    def _abandon_job(self, job_id: int) -> None:
+        job = self._jobs.get(job_id)
+        if job is None:
+            return
+        job.done = True
+        self._retire_job(job)
+
+    def _retire_job(self, job: _Job) -> None:
+        """Common teardown: cancel in-flight chunks, forget queued work.
+
+        Cancelled miners are marked idle immediately — a cancelled worker
+        sends no Result, so nothing else would ever free them. If the
+        Cancel loses the race with the chunk's completion, the late
+        Result's chunk_id no longer matches and is ignored.
+        """
+        job.ranges.clear()
+        for miner_conn in list(job.inflight):
+            job.inflight.pop(miner_conn)
+            miner = self._miners.get(miner_conn)
+            if miner is not None and miner.chunk is not None \
+                    and miner.chunk[1] == job.job_id:
+                miner.chunk = None
+            try:
+                self._server.write(miner_conn, encode_msg(Cancel(job.job_id)))
+            except ConnectionError:
+                pass
+        try:
+            self._rotation.remove(job.job_id)
+        except ValueError:
+            pass
+        self._jobs.pop(job.job_id, None)
+        client_jobs = self._clients.get(job.client_conn)
+        if client_jobs is not None:
+            client_jobs.discard(job.job_id)
+
+    # -- dispatch --------------------------------------------------------
+
+    def _dispatch(self) -> None:
+        """Carve chunks off round-robin'd jobs onto idle miners (§3.3)."""
+        idle = deque(m for m in self._miners.values() if m.chunk is None)
+        while idle and self._rotation:
+            job_id = self._rotation[0]
+            job = self._jobs.get(job_id)
+            if job is None or job.done or not job.ranges:
+                self._rotation.popleft()
+                continue
+            miner = idle.popleft()
+            lo, hi = job.ranges.popleft()
+            take = min(hi - lo + 1, self._chunk_size * miner.lanes)
+            chunk_hi = lo + take - 1
+            if chunk_hi < hi:
+                job.ranges.appendleft((chunk_hi + 1, hi))
+            chunk_id = self._next_chunk_id
+            self._next_chunk_id += 1
+            miner.chunk = (chunk_id, job_id, lo, chunk_hi)
+            job.inflight[miner.conn_id] = (lo, chunk_hi)
+            req = job.request
+            try:
+                self._server.write(
+                    miner.conn_id,
+                    encode_msg(
+                        Request(
+                            job_id=job_id,
+                            mode=req.mode,
+                            lower=lo,
+                            upper=chunk_hi,
+                            data=req.data,
+                            header=req.header,
+                            target=req.target,
+                            chunk_id=chunk_id,
+                        )
+                    ),
+                )
+            except ConnectionError:
+                # lost between our bookkeeping and the write; undo
+                miner.chunk = None
+                job.inflight.pop(miner.conn_id, None)
+                job.ranges.appendleft((lo, chunk_hi))
+                continue
+            # rotate: next dispatch serves the next job
+            self._rotation.rotate(-1)
+
+
+def main(argv: Optional[list] = None) -> None:
+    """CLI: ``python -m tpuminter.coordinator <port>``
+    (≙ reference ``./server <port>``)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(description="tpuminter coordinator (server role)")
+    parser.add_argument("port", type=int)
+    parser.add_argument("--chunk-size", type=int, default=DEFAULT_CHUNK_SIZE)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    async def _run() -> None:
+        coord = await Coordinator.create(args.port, chunk_size=args.chunk_size)
+        log.info("coordinator listening on port %d", coord.port)
+        await coord.serve()
+
+    asyncio.run(_run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,216 @@
+"""Application protocol: the messages that travel between roles.
+
+Capability-equivalent rebuild of the reference's ``bitcoin/message.go``
+(SURVEY.md §2 #7; mount empty per §0): ``Join`` / ``Request`` / ``Result``
+carried as LSP payloads. Like the reference we JSON-encode the app layer
+(the frames below it are binary); unlike the reference, a ``Request``
+speaks two proof-of-work dialects:
+
+- ``PowMode.MIN`` — the reference's toy PoW: over ``[lower, upper]``
+  (inclusive, as in the reference), find the nonce *minimizing*
+  ``toy_hash(data, nonce)``.
+- ``PowMode.TARGET`` — the real-Bitcoin capability delta demanded by
+  BASELINE.json:6-12: find any nonce with
+  ``double-SHA256(header ‖ nonce) <= target``.
+
+Both dialects fold the same way: every chunk Result carries the *minimum*
+hash over its range and the argmin nonce, which is an associative
+reduction the coordinator (and, on device, ``jax.lax`` argmin trees) can
+combine in any order. TARGET mode additionally sets ``found`` when the
+minimum beats the target, which lets the coordinator early-exit the job
+and ``Cancel`` the other in-flight chunks — the control-plane half of the
+"whole pod stops on the first sub-target hash" story (BASELINE.json:5;
+the on-device half is the ICI or-reduce in ``tpuminter.mesh``).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from enum import Enum
+from typing import Optional, Union
+
+__all__ = [
+    "PowMode",
+    "Join",
+    "Request",
+    "Result",
+    "Cancel",
+    "Message",
+    "encode_msg",
+    "decode_msg",
+    "ProtocolError",
+]
+
+
+class ProtocolError(ValueError):
+    """A payload that is not a well-formed app message."""
+
+
+class PowMode(str, Enum):
+    MIN = "min"        # toy PoW: minimize uint64 fold (reference parity)
+    TARGET = "target"  # real PoW: double-SHA256(header) <= target
+
+
+@dataclass(frozen=True)
+class Join:
+    """Worker → coordinator: I am a miner, give me work.
+
+    ``backend`` names the worker implementation ("cpu", "jax", "tpu",
+    "native"); ``lanes`` is a relative-throughput hint the scheduler may
+    use to size chunks (1 = one CPU core's worth).
+    """
+
+    backend: str = "cpu"
+    lanes: int = 1
+
+
+@dataclass(frozen=True)
+class Request:
+    """Coordinator → worker: mine this nonce range. Also client →
+    coordinator, where ``[lower, upper]`` is the whole job's range.
+
+    MIN mode uses ``data``; TARGET mode uses ``header`` (80 bytes, nonce
+    field ignored) + ``target`` (256-bit integer). ``upper`` is inclusive
+    and bounded by the dialect's nonce width (2^32-1 for TARGET — the
+    header nonce field is u32; 2^64-1 for MIN) so no range a worker
+    accepts can overflow its hot loop. ``chunk_id`` identifies this
+    specific dispatch; workers echo it in their Result so the scheduler
+    can tell a live chunk's answer from a stale one (see coordinator).
+    """
+
+    job_id: int
+    mode: PowMode
+    lower: int
+    upper: int
+    data: bytes = b""
+    header: Optional[bytes] = None
+    target: Optional[int] = None
+    chunk_id: int = 0
+
+    def __post_init__(self) -> None:
+        limit = 0xFFFFFFFF if self.mode == PowMode.TARGET else 0xFFFFFFFFFFFFFFFF
+        if self.lower < 0 or self.upper < self.lower or self.upper > limit:
+            raise ProtocolError(f"bad nonce range [{self.lower}, {self.upper}]")
+        if self.mode == PowMode.TARGET:
+            if self.header is None or len(self.header) != 80:
+                raise ProtocolError("TARGET mode needs an 80-byte header")
+            if self.target is None or self.target <= 0:
+                raise ProtocolError("TARGET mode needs a positive target")
+
+
+@dataclass(frozen=True)
+class Result:
+    """Worker → coordinator (per chunk) and coordinator → client (final).
+
+    ``hash_value`` is the minimum hash over the searched range — a uint64
+    for MIN mode, the uint256 little-endian integer of the double-SHA
+    digest for TARGET mode — and ``nonce`` its argmin. ``found`` is True
+    in MIN mode always, in TARGET mode iff ``hash_value <= target``.
+    ``searched`` is the number of nonces actually examined (less than the
+    range size when a TARGET hit early-exits a chunk); the coordinator's
+    final Result to the client carries the job-wide total. ``chunk_id``
+    echoes the Request being answered.
+    """
+
+    job_id: int
+    mode: PowMode
+    nonce: int
+    hash_value: int
+    found: bool = True
+    searched: int = 0
+    chunk_id: int = 0
+
+
+@dataclass(frozen=True)
+class Cancel:
+    """Coordinator → worker: stop mining ``job_id``, its answer is in.
+
+    No reference analogue (the reference lets stale chunks run to
+    completion and drops their results); a framework-grade scheduler wants
+    the early-exit to propagate so device time isn't burned on dead work.
+    Workers treat it as advisory — a late Result is still ignored server
+    side.
+    """
+
+    job_id: int
+
+
+Message = Union[Join, Request, Result, Cancel]
+
+_KINDS = {"join": Join, "request": Request, "result": Result, "cancel": Cancel}
+
+
+def encode_msg(msg: Message) -> bytes:
+    """Serialize an app message to a (JSON) LSP payload."""
+    if isinstance(msg, Join):
+        obj = {"kind": "join", "backend": msg.backend, "lanes": msg.lanes}
+    elif isinstance(msg, Request):
+        obj = {
+            "kind": "request",
+            "job_id": msg.job_id,
+            "mode": msg.mode.value,
+            "lower": msg.lower,
+            "upper": msg.upper,
+            "chunk_id": msg.chunk_id,
+        }
+        if msg.data:
+            obj["data"] = msg.data.hex()
+        if msg.header is not None:
+            obj["header"] = msg.header.hex()
+        if msg.target is not None:
+            obj["target"] = f"{msg.target:x}"
+    elif isinstance(msg, Result):
+        obj = {
+            "kind": "result",
+            "job_id": msg.job_id,
+            "mode": msg.mode.value,
+            "nonce": msg.nonce,
+            "hash": f"{msg.hash_value:x}",
+            "found": msg.found,
+            "searched": msg.searched,
+            "chunk_id": msg.chunk_id,
+        }
+    elif isinstance(msg, Cancel):
+        obj = {"kind": "cancel", "job_id": msg.job_id}
+    else:
+        raise ProtocolError(f"not an app message: {msg!r}")
+    return json.dumps(obj, separators=(",", ":")).encode()
+
+
+def decode_msg(raw: bytes) -> Message:
+    """Parse an LSP payload back into an app message."""
+    try:
+        obj = json.loads(raw)
+    except (ValueError, UnicodeDecodeError) as exc:
+        raise ProtocolError(f"payload is not JSON: {exc}") from exc
+    if not isinstance(obj, dict) or obj.get("kind") not in _KINDS:
+        raise ProtocolError(f"unknown message kind: {obj!r}")
+    kind = obj["kind"]
+    try:
+        if kind == "join":
+            return Join(backend=str(obj.get("backend", "cpu")), lanes=int(obj.get("lanes", 1)))
+        if kind == "request":
+            return Request(
+                job_id=int(obj["job_id"]),
+                mode=PowMode(obj["mode"]),
+                lower=int(obj["lower"]),
+                upper=int(obj["upper"]),
+                data=bytes.fromhex(obj["data"]) if "data" in obj else b"",
+                header=bytes.fromhex(obj["header"]) if "header" in obj else None,
+                target=int(obj["target"], 16) if "target" in obj else None,
+                chunk_id=int(obj.get("chunk_id", 0)),
+            )
+        if kind == "result":
+            return Result(
+                job_id=int(obj["job_id"]),
+                mode=PowMode(obj["mode"]),
+                nonce=int(obj["nonce"]),
+                hash_value=int(obj["hash"], 16),
+                found=bool(obj["found"]),
+                searched=int(obj.get("searched", 0)),
+                chunk_id=int(obj.get("chunk_id", 0)),
+            )
+        return Cancel(job_id=int(obj["job_id"]))
+    except (KeyError, ValueError, TypeError) as exc:
+        raise ProtocolError(f"malformed {kind} message: {exc}") from exc

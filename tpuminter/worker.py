@@ -1,0 +1,234 @@
+"""Worker role: the Miner interface and the CPU reference miner.
+
+Capability-equivalent rebuild of the reference's ``bitcoin/miner/miner.go``
+(SURVEY.md §2 #9, §3.2; mount empty per §0): connect, ``Join``, then loop
+{ read Request → search the nonce range → write Result }, exiting when the
+coordinator connection is declared lost.
+
+Two deliberate departures from the reference shape, both demanded by the
+north-star (BASELINE.json:5 "a new TPUMiner satisfies the existing
+Miner/Worker interface"):
+
+- **The Miner interface is a cooperative generator,** not a blocking
+  call: ``mine(request)`` yields ``None`` between batches and finally a
+  ``Result``. The async role loop interleaves those yields with the LSP
+  event loop, so heartbeats keep flowing while mining (the reference gets
+  this from goroutines; asyncio needs explicit yield points) — and a
+  ``Cancel`` for the active job can interrupt mid-range. Device-backed
+  miners use the same seam to overlap host control with device compute.
+- **Two PoW dialects** (``protocol.PowMode``): the reference's min-hash
+  search, and real ``double-SHA256(header ‖ nonce) <= target``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from typing import Callable, Iterator, Optional
+
+from tpuminter import chain
+from tpuminter.lsp import LspClient, LspConnectionLost, Params
+from tpuminter.lsp.params import FAST
+from tpuminter.protocol import (
+    Cancel,
+    Join,
+    Message,
+    PowMode,
+    ProtocolError,
+    Request,
+    Result,
+    decode_msg,
+    encode_msg,
+)
+
+__all__ = ["Miner", "CpuMiner", "run_miner", "main"]
+
+log = logging.getLogger("tpuminter.worker")
+
+
+class Miner:
+    """The Worker interface every backend satisfies (BASELINE.json:5).
+
+    Subclasses set ``backend``/``lanes`` (advertised in ``Join``) and
+    implement :meth:`mine` as a generator: yield ``None`` whenever it is
+    safe to pause (a batch boundary), then yield the chunk's ``Result``
+    exactly once and return. The caller may simply abandon the generator
+    (on Cancel), so resources must not depend on exhaustion.
+    """
+
+    backend = "abstract"
+    lanes = 1
+
+    def mine(self, request: Request) -> Iterator[Optional[Result]]:
+        raise NotImplementedError
+
+
+class CpuMiner(Miner):
+    """hashlib-backed reference miner (≙ the reference's Go hot loop).
+
+    The baseline every accelerated backend is measured against
+    (SURVEY.md §6). ``batch`` bounds work between yield points.
+    """
+
+    backend = "cpu"
+
+    def __init__(self, batch: int = 4096):
+        self.batch = batch
+
+    def mine(self, request: Request) -> Iterator[Optional[Result]]:
+        if request.mode == PowMode.MIN:
+            yield from self._mine_min(request)
+        else:
+            yield from self._mine_target(request)
+
+    def _mine_min(self, req: Request) -> Iterator[Optional[Result]]:
+        best_hash, best_nonce = None, req.lower
+        nonce = req.lower
+        while nonce <= req.upper:
+            stop = min(nonce + self.batch, req.upper + 1)
+            for n in range(nonce, stop):
+                h = chain.toy_hash(req.data, n)
+                if best_hash is None or h < best_hash:
+                    best_hash, best_nonce = h, n
+            nonce = stop
+            if nonce <= req.upper:
+                yield None
+        yield Result(
+            req.job_id, req.mode, best_nonce, best_hash, found=True,
+            searched=req.upper - req.lower + 1, chunk_id=req.chunk_id,
+        )
+
+    def _mine_target(self, req: Request) -> Iterator[Optional[Result]]:
+        assert req.header is not None and req.target is not None
+        prefix = req.header[:76]
+        best_hash, best_nonce = None, req.lower
+        nonce = req.lower
+        while nonce <= req.upper:
+            stop = min(nonce + self.batch, req.upper + 1)
+            for n in range(nonce, stop):
+                h = chain.hash_to_int(chain.dsha256(prefix + struct.pack("<I", n)))
+                if best_hash is None or h < best_hash:
+                    best_hash, best_nonce = h, n
+                    if h <= req.target:  # early exit: a winner ends the chunk
+                        yield Result(
+                            req.job_id, req.mode, n, h, found=True,
+                            searched=n - req.lower + 1, chunk_id=req.chunk_id,
+                        )
+                        return
+            nonce = stop
+            if nonce <= req.upper:
+                yield None
+        yield Result(
+            req.job_id, req.mode, best_nonce, best_hash,
+            found=best_hash <= req.target,
+            searched=req.upper - req.lower + 1, chunk_id=req.chunk_id,
+        )
+
+
+async def run_miner(
+    host: str,
+    port: int,
+    miner: Miner,
+    *,
+    params: Optional[Params] = None,
+    on_result: Optional[Callable[[Result], None]] = None,
+) -> None:
+    """Worker role main loop; returns when the coordinator is lost.
+
+    ≙ reference ``miner.go`` ``main`` (SURVEY.md §3.2), with Cancel
+    handling layered in: while a chunk is being mined, an LSP read is kept
+    in flight so a ``Cancel`` for the active job abandons it immediately;
+    any other message read mid-mine is queued and handled after.
+    """
+    client = await LspClient.connect(host, port, params or FAST)
+    client.write(encode_msg(Join(backend=miner.backend, lanes=miner.lanes)))
+    pending: "asyncio.Queue[Message]" = asyncio.Queue()
+    read_task: Optional[asyncio.Task] = None
+    try:
+        while True:
+            # -- next message: drained backlog first, then the wire ------
+            if not pending.empty():
+                msg = pending.get_nowait()
+            else:
+                if read_task is None:
+                    read_task = asyncio.ensure_future(client.read())
+                raw = await read_task
+                read_task = None
+                msg = _safe_decode(raw)
+                if msg is None:
+                    continue
+            if isinstance(msg, Cancel):
+                continue  # for a job we are not mining: stale, drop
+            if not isinstance(msg, Request):
+                log.warning("worker: unexpected %s, dropping", type(msg).__name__)
+                continue
+
+            # -- mine, keeping one read in flight for Cancel -------------
+            result: Optional[Result] = None
+            cancelled = False
+            for item in miner.mine(msg):
+                if item is not None:
+                    result = item
+                    break
+                if read_task is None:
+                    read_task = asyncio.ensure_future(client.read())
+                if read_task.done():
+                    raw = read_task.result()  # raises here if conn lost
+                    read_task = None
+                    inner = _safe_decode(raw)
+                    if isinstance(inner, Cancel) and inner.job_id == msg.job_id:
+                        cancelled = True
+                        break
+                    if inner is not None:
+                        pending.put_nowait(inner)
+                await asyncio.sleep(0)  # let the LSP event loop breathe
+            if cancelled or result is None:
+                log.info("worker: job %d cancelled mid-chunk", msg.job_id)
+                continue
+            if on_result is not None:
+                on_result(result)
+            client.write(encode_msg(result))
+    except LspConnectionLost:
+        log.info("worker: coordinator lost, exiting")
+    finally:
+        if read_task is not None:
+            read_task.cancel()
+        await client.close(drain_timeout=2.0)
+
+
+def _safe_decode(raw: bytes) -> Optional[Message]:
+    try:
+        return decode_msg(raw)
+    except ProtocolError as exc:
+        log.warning("worker: dropping malformed message: %s", exc)
+        return None
+
+
+def _build_miner(backend: str) -> Miner:
+    """Backend registry for the CLI; device backends import lazily."""
+    if backend == "cpu":
+        return CpuMiner()
+    if backend == "jax":
+        from tpuminter.jax_worker import JaxMiner
+
+        return JaxMiner()
+    raise SystemExit(f"unknown backend {backend!r} (expected cpu|jax)")
+
+
+def main(argv: Optional[list] = None) -> None:
+    """CLI: ``python -m tpuminter.worker <host:port> [--backend cpu]``
+    (≙ reference ``./miner <host:port>``)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(description="tpuminter worker (miner role)")
+    parser.add_argument("hostport", help="coordinator address, host:port")
+    parser.add_argument("--backend", default="cpu", help="cpu|jax (default cpu)")
+    args = parser.parse_args(argv)
+    host, _, port = args.hostport.rpartition(":")
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(run_miner(host or "127.0.0.1", int(port), _build_miner(args.backend)))
+
+
+if __name__ == "__main__":
+    main()
