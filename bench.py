@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Headline benchmark: double-SHA-256 throughput per chip (BASELINE.json:2).
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": "GH/s", "vs_baseline": N}``
+
+``vs_baseline`` is measured throughput over the north-star target of
+1 GH/s/chip on v5e (BASELINE.json:5 — the reference publishes no numbers
+of its own, SURVEY.md §6, so the target is the denominator).
+
+Runs on the default backend (the real TPU chip under the driver; CPU
+works for a smoke run with BENCH_SMOKE=1). The hot loop is the jnp/XLA
+search step; when the Pallas kernel lands it swaps in behind the same
+call. Steps are queued without per-step host sync (JAX async dispatch) so
+the device pipeline stays full; only the final flag forces a sync.
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+from tpuminter import chain
+from tpuminter.ops import sha256 as ops
+
+
+def bench_double_sha256(batch: int, secs: float = 3.0):
+    template = ops.header_template(chain.GENESIS_HEADER.pack())
+    # genesis difficulty: nothing in a random window beats it, so the
+    # found-flag stays cold and we measure pure search throughput
+    target_words = jnp.asarray(
+        ops.target_to_words(chain.bits_to_target(0x1D00FFFF))
+    )
+
+    @jax.jit
+    def step(start):
+        nonces = start + jnp.arange(batch, dtype=jnp.uint32)
+        digests = ops.double_sha256_header_batch(template, nonces)
+        ok = ops.lex_le(ops.hash_words_be(digests), target_words)
+        return ok.any()
+
+    step(jnp.uint32(0)).block_until_ready()  # compile
+    # calibrate iteration count to ~secs of wall clock
+    t0 = time.perf_counter()
+    step(jnp.uint32(1)).block_until_ready()
+    per_step = max(time.perf_counter() - t0, 1e-5)
+    iters = max(3, int(secs / per_step))
+    flags = []
+    t0 = time.perf_counter()
+    for i in range(iters):
+        # wrapping start values are fine for a throughput measurement
+        flags.append(step(jnp.uint32((i * batch) & 0xFFFFFFFF)))
+    flags[-1].block_until_ready()
+    dt = time.perf_counter() - t0
+    return batch * iters / dt
+
+
+def main() -> None:
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    if smoke:
+        jax.config.update("jax_platforms", "cpu")
+    batch = 1 << 14 if smoke else 1 << 21
+    rate = bench_double_sha256(batch, secs=1.0 if smoke else 3.0)
+    ghs = rate / 1e9
+    print(
+        json.dumps(
+            {
+                "metric": "double_sha256_ghs_per_chip",
+                "value": round(ghs, 6),
+                "unit": "GH/s",
+                "vs_baseline": round(ghs / 1.0, 6),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

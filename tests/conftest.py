@@ -9,9 +9,21 @@ set before jax imports.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# Env-var platform selection (JAX_PLATFORMS=cpu) is NOT enough in this
+# image: a sitecustomize hook registers the experimental TPU-tunnel
+# backend at interpreter start and wins the selection. Forcing the config
+# key after import reliably pins tests to the fake-8-device CPU mesh.
+import jax  # noqa: E402  (after XLA_FLAGS above, by design)
+
+jax.config.update("jax_platforms", "cpu")
+
+# The unrolled SHA-256 graphs are trace-heavy; cache compiled executables
+# across test runs so only the first run pays the compile bill.
+jax.config.update("jax_compilation_cache_dir", "/tmp/tpuminter-jax-cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -1,0 +1,106 @@
+"""JaxMiner tests: the device-backed Worker must be bit-identical to the
+CPU reference through the same Miner interface (BASELINE.json:5), on the
+CPU backend (tests/conftest.py)."""
+
+import asyncio
+import struct
+
+from tpuminter import chain
+from tpuminter.client import submit
+from tpuminter.jax_worker import JaxMiner
+from tpuminter.protocol import PowMode, Request
+from tpuminter.worker import CpuMiner
+
+from tests.test_e2e import FAST, Cluster, brute_min, run
+
+
+def drain(gen):
+    for item in gen:
+        if item is not None:
+            return item
+    raise AssertionError("miner generator ended without a Result")
+
+
+def test_min_mode_matches_brute_force():
+    miner = JaxMiner(batch=512)
+    req = Request(job_id=1, mode=PowMode.MIN, lower=100, upper=3000,
+                  data=b"jax parity")
+    result = drain(miner.mine(req))
+    want_hash, want_nonce = brute_min(b"jax parity", 100, 3000)
+    assert (result.hash_value, result.nonce) == (want_hash, want_nonce)
+    assert result.searched == 2901
+
+
+def test_min_mode_beyond_32_bit_nonces():
+    miner = JaxMiner(batch=512)
+    lower = (1 << 33) + 5
+    req = Request(job_id=1, mode=PowMode.MIN, lower=lower, upper=lower + 999,
+                  data=b"wide nonces")
+    result = drain(miner.mine(req))
+    want = brute_min(b"wide nonces", lower, lower + 999)
+    assert (result.hash_value, result.nonce) == want
+
+
+def test_min_mode_at_top_of_64_bit_space():
+    """Regression: the ragged final batch at the 2^64 ceiling must pad
+    with `upper`, not wrap modulo 64 bits into out-of-range nonces."""
+    miner = JaxMiner(batch=512)
+    upper = 2**64 - 1
+    lower = upper - 99
+    req = Request(job_id=1, mode=PowMode.MIN, lower=lower, upper=upper,
+                  data=b"ceiling")
+    result = drain(miner.mine(req))
+    want = brute_min(b"ceiling", lower, upper)
+    assert (result.hash_value, result.nonce) == want
+    assert lower <= result.nonce <= upper
+
+
+def test_target_mode_finds_genesis():
+    miner = JaxMiner(batch=512)
+    n = chain.GENESIS_HEADER.nonce
+    req = Request(
+        job_id=1, mode=PowMode.TARGET, lower=n - 600, upper=n + 600,
+        header=chain.GENESIS_HEADER.pack(),
+        target=chain.bits_to_target(0x1D00FFFF),
+    )
+    result = drain(miner.mine(req))
+    assert result.found
+    assert result.nonce == n
+    assert result.hash_value == chain.GENESIS_HEADER.block_hash_int()
+    # searched counts only up to the hit
+    assert result.searched == (n - (n - 600)) + 1
+
+
+def test_target_mode_exhausted_matches_cpu_miner():
+    req = Request(
+        job_id=1, mode=PowMode.TARGET, lower=0, upper=2047,
+        header=chain.GENESIS_HEADER.pack(),
+        target=chain.bits_to_target(0x1D00FFFF),
+    )
+    jax_result = drain(JaxMiner(batch=512).mine(req))
+    cpu_result = drain(CpuMiner().mine(req))
+    assert not jax_result.found
+    assert (jax_result.hash_value, jax_result.nonce) == (
+        cpu_result.hash_value, cpu_result.nonce,
+    )
+    assert jax_result.searched == 2048
+
+
+def test_mixed_backend_cluster():
+    """CpuMiner and JaxMiner mining the same job side by side — the
+    heterogeneous-worker story the lane-scaled chunking exists for."""
+
+    async def scenario():
+        cluster = await Cluster.create(n_miners=1, chunk_size=1024)
+        try:
+            await cluster.add_miner(JaxMiner(batch=512, lanes=2))
+            data = b"mixed fleet"
+            req = Request(job_id=1, mode=PowMode.MIN, lower=0, upper=30_000,
+                          data=data)
+            result = await submit("127.0.0.1", cluster.coord.port, req,
+                                  params=FAST)
+            assert (result.hash_value, result.nonce) == brute_min(data, 0, 30_000)
+        finally:
+            await cluster.close()
+
+    run(scenario())

@@ -1,0 +1,146 @@
+"""JaxMiner: the device-backed Worker (SURVEY.md §7 stage 3).
+
+Satisfies the same ``worker.Miner`` generator contract as ``CpuMiner`` —
+the BASELINE.json:5 requirement that accelerated backends slot into the
+existing Miner/Worker interface — but runs each batch of nonces through
+the jnp SHA-256 ops (``tpuminter.ops``) under ``jit``. On the CPU backend
+this is the CI-testable stand-in; on TPU the same code drives the chip,
+and the Pallas kernels (``tpuminter.kernels``) swap in underneath via the
+``step_impl`` seam without touching the role layer.
+
+Batching discipline (XLA semantics): every batch has the SAME static
+shape — the final ragged batch is padded by clamping nonces to ``upper``
+(duplicate nonces cannot change a min fold, and any padded winner still
+names a valid in-range nonce) — so each (template, batch) pair compiles
+exactly once.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpuminter.ops import sha256 as ops
+from tpuminter.protocol import PowMode, Request, Result
+from tpuminter.worker import Miner
+
+__all__ = ["JaxMiner"]
+
+
+@partial(jax.jit, static_argnums=0)
+def _min_step(
+    template: ops.NonceTemplate, nonce_hi: jnp.ndarray, nonce_lo: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Toy dialect: batch → (argmin index, its (hi, lo) u32 fold pair)."""
+    digests = ops.sha256_batch(template, nonce_hi, nonce_lo)
+    fold = digests[:, :2]  # toy_hash = first 8 digest bytes, big-endian
+    idx = ops.lex_argmin(fold)
+    return idx, fold[idx]
+
+
+@partial(jax.jit, static_argnums=0)
+def _target_step(
+    template: ops.NonceTemplate, nonces: jnp.ndarray, target_words: jnp.ndarray
+):
+    """Bitcoin dialect: batch → (any_found, first_found_idx, min_idx,
+    min_digest_words, first_found_digest_words)."""
+    digests = ops.double_sha256_header_batch(template, nonces)
+    hw = ops.hash_words_be(digests)
+    ok = ops.lex_le(hw, target_words)
+    found = ok.any()
+    first = jnp.argmax(ok)  # 0 when none found; guarded by `found`
+    midx = ops.lex_argmin(hw)
+    return found, first, midx, digests[midx], digests[first]
+
+
+class JaxMiner(Miner):
+    """Batched device miner behind the standard Worker interface."""
+
+    backend = "jax"
+
+    def __init__(self, batch: int = 1 << 16, lanes: Optional[int] = None):
+        self.batch = batch
+        # scheduler hint: ask the coordinator for chunks a few batches deep
+        self.lanes = lanes if lanes is not None else max(1, (batch * 4) // 16_384)
+
+    # -- Miner interface -------------------------------------------------
+
+    def mine(self, request: Request) -> Iterator[Optional[Result]]:
+        if request.mode == PowMode.MIN:
+            yield from self._mine_min(request)
+        else:
+            yield from self._mine_target(request)
+
+    # -- internals -------------------------------------------------------
+
+    def _batches(self, lower: int, upper: int):
+        """Fixed-shape nonce batches covering [lower, upper], final batch
+        padded with ``upper``; yields (start, valid_count, np_u64_array).
+
+        The pad is built explicitly (not by clamping a full arange) so a
+        range ending near 2^64 cannot wrap modulo 64 bits and leak
+        out-of-range nonces into the batch.
+        """
+        start = lower
+        while start <= upper:
+            valid = min(self.batch, upper - start + 1)
+            nonces = np.uint64(start) + np.arange(valid, dtype=np.uint64)
+            if valid < self.batch:
+                nonces = np.concatenate(
+                    [nonces, np.full(self.batch - valid, upper, dtype=np.uint64)]
+                )
+            yield start, valid, nonces
+            start += valid
+
+    def _mine_min(self, req: Request) -> Iterator[Optional[Result]]:
+        template = ops.toy_template(req.data)
+        best: Optional[Tuple[int, int]] = None  # (hash, nonce)
+        for start, valid, nonces in self._batches(req.lower, req.upper):
+            hi = jnp.asarray((nonces >> np.uint64(32)).astype(np.uint32))
+            lo = jnp.asarray((nonces & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+            idx, fold = _min_step(template, hi, lo)
+            idx = int(idx)
+            h = (int(fold[0]) << 32) | int(fold[1])
+            cand = (h, int(nonces[idx]))
+            if best is None or cand < best:
+                best = cand
+            yield None
+        yield Result(
+            req.job_id, req.mode, best[1], best[0], found=True,
+            searched=req.upper - req.lower + 1, chunk_id=req.chunk_id,
+        )
+
+    def _mine_target(self, req: Request) -> Iterator[Optional[Result]]:
+        assert req.header is not None and req.target is not None
+        template = ops.header_template(req.header)
+        target_words = jnp.asarray(ops.target_to_words(req.target))
+        best: Optional[Tuple[int, int]] = None  # (hash, nonce)
+        for start, valid, nonces in self._batches(req.lower, req.upper):
+            batch = jnp.asarray(nonces.astype(np.uint32))
+            found, first, midx, min_digest, first_digest = _target_step(
+                template, batch, target_words
+            )
+            if bool(found):
+                first = int(first)
+                nonce = int(nonces[first])
+                h = ops.digest_to_int(np.asarray(first_digest))
+                yield Result(
+                    req.job_id, req.mode, nonce, h, found=True,
+                    searched=min(first + 1, valid) + (start - req.lower),
+                    chunk_id=req.chunk_id,
+                )
+                return
+            midx = int(midx)
+            cand = (ops.digest_to_int(np.asarray(min_digest)), int(nonces[midx]))
+            if best is None or cand < best:
+                best = cand
+            yield None
+        yield Result(
+            req.job_id, req.mode, best[1], best[0],
+            found=best[0] <= req.target,
+            searched=req.upper - req.lower + 1, chunk_id=req.chunk_id,
+        )

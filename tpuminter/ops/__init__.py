@@ -1,0 +1,36 @@
+"""Device-side ops: jnp/XLA implementations of the mining hot path.
+
+This is the data plane (SURVEY.md §7 stage 3): pure-functional SHA-256
+on u32 vectors, vmappable over a nonce batch, plus the lexicographic
+256-bit compare/argmin primitives the search needs. ``tpuminter.kernels``
+holds the hand-written Pallas versions of the same contracts; everything
+here also runs on the CPU backend for CI (tests/conftest.py).
+"""
+
+from tpuminter.ops.sha256 import (
+    NonceTemplate,
+    compress,
+    digest_to_int,
+    double_sha256_header_batch,
+    hash_words_be,
+    header_template,
+    lex_argmin,
+    lex_le,
+    sha256_batch,
+    target_to_words,
+    toy_template,
+)
+
+__all__ = [
+    "NonceTemplate",
+    "compress",
+    "digest_to_int",
+    "double_sha256_header_batch",
+    "hash_words_be",
+    "header_template",
+    "lex_argmin",
+    "lex_le",
+    "sha256_batch",
+    "target_to_words",
+    "toy_template",
+]

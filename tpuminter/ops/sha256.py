@@ -1,0 +1,359 @@
+"""SHA-256 on device: jnp u32 vectors, vmapped over nonce batches.
+
+The TPU-first design (SURVEY.md §7 stage 3-4; north-star BASELINE.json:5):
+
+- **Midstate specialization.** A mining message is constant except for a
+  few nonce bytes near the end. All 64-byte blocks before the first
+  nonce-bearing word are compressed ONCE on the host
+  (``chain.midstate``-style); the device only compresses the remaining
+  "tail" block(s) per candidate. For an 80-byte Bitcoin header that is 1
+  tail block + the 1-block second hash — 2 compressions per nonce
+  instead of 3.
+- **Trace-time message templates.** Where the nonce bytes land in the
+  tail (block, word, intra-word shift) depends only on the job, never on
+  the candidate, so a :class:`NonceTemplate` carries those positions as
+  *Python ints* and the jitted batch functions close over them — all
+  indexing is static, XLA sees straight-line u32 ALU code it can tile
+  onto the VPU. No dynamic shapes, no data-dependent control flow.
+- **64-bit nonces as u32 pairs.** The toy dialect's nonce space is
+  2^64; JAX's default (and TPU-native) int width is 32, so nonces travel
+  as ``(hi, lo)`` u32 vectors and 64-bit/256-bit comparisons are
+  lexicographic over u32 lanes (:func:`lex_le`, :func:`lex_argmin`).
+
+Everything is pure; no global state. Host-side reference semantics live
+in ``tpuminter.chain`` (verified against hashlib / the genesis block);
+the equivalence tests in tests/test_ops_sha256.py pin this module to it.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpuminter.chain import SHA256_H0, SHA256_K, sha256_compress
+
+__all__ = [
+    "compress",
+    "NonceTemplate",
+    "toy_template",
+    "header_template",
+    "sha256_batch",
+    "double_sha256_header_batch",
+    "hash_words_be",
+    "lex_le",
+    "lex_argmin",
+    "target_to_words",
+    "digest_to_int",
+]
+
+_K = tuple(np.uint32(k) for k in SHA256_K)
+_K_ARR = np.array(SHA256_K, dtype=np.uint32)
+_H0 = np.array(SHA256_H0, dtype=np.uint32)
+
+
+def _rotr(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    # TPUs have no rotate instruction; XLA lowers this shift/or pair onto
+    # the VPU (pallas_guide: same form the hand kernel uses).
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _round_unroll() -> bool:
+    """Unroll the 64 rounds at trace time only where it pays off.
+
+    TPU: XLA handles the flat ~7k-op graph fine and straight-line code
+    schedules best. CPU (the CI backend): LLVM chokes on the huge basic
+    block (minutes of compile per template), while a ``lax.scan`` over
+    rounds compiles in seconds and runs vectorized — the right tradeoff
+    for a correctness backend.
+    """
+    return jax.default_backend() not in ("cpu",)
+
+
+def compress(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
+    """One SHA-256 compression: ``state (..., 8) u32``, ``block (..., 16)
+    u32`` → ``(..., 8) u32``, elementwise over leading batch dims.
+
+    ≡ ``chain.sha256_compress`` (FIPS 180-4). The message schedule is
+    computed on the fly inside the round loop via the classic rolling
+    16-word window (w[i+16] = w[i] + σ0(w[i+1]) + w[i+9] + σ1(w[i+14])),
+    which keeps the scanned form O(1) state; the unrolled form emits the
+    same dataflow flattened.
+    """
+    if _round_unroll():
+        return _compress_unrolled(state, block)
+    return _compress_scanned(state, block)
+
+
+def _schedule_next(win: jnp.ndarray) -> jnp.ndarray:
+    """w[i+16] from the window w[i..i+15] (last axis)."""
+    s0 = (
+        _rotr(win[..., 1], 7) ^ _rotr(win[..., 1], 18) ^ (win[..., 1] >> np.uint32(3))
+    )
+    s1 = (
+        _rotr(win[..., 14], 17)
+        ^ _rotr(win[..., 14], 19)
+        ^ (win[..., 14] >> np.uint32(10))
+    )
+    return win[..., 0] + s0 + win[..., 9] + s1
+
+
+def _one_round(vars8, k_plus_w):
+    a, b, c, d, e, f, g, h = vars8
+    s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+    ch = (e & f) ^ (~e & g)
+    t1 = h + s1 + ch + k_plus_w
+    s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+    maj = (a & b) ^ (a & c) ^ (b & c)
+    t2 = s0 + maj
+    return (t1 + t2, a, b, c, d + t1, e, f, g)
+
+
+def _compress_unrolled(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
+    w = [block[..., i] for i in range(16)]
+    for i in range(16, 64):
+        s0 = _rotr(w[i - 15], 7) ^ _rotr(w[i - 15], 18) ^ (w[i - 15] >> np.uint32(3))
+        s1 = _rotr(w[i - 2], 17) ^ _rotr(w[i - 2], 19) ^ (w[i - 2] >> np.uint32(10))
+        w.append(w[i - 16] + s0 + w[i - 7] + s1)
+    vars8 = tuple(state[..., i] for i in range(8))
+    for i in range(64):
+        vars8 = _one_round(vars8, _K[i] + w[i])
+    return jnp.stack(
+        [state[..., i] + vars8[i] for i in range(8)], axis=-1
+    )
+
+
+def _compress_scanned(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
+    def step(carry, k):
+        vars8, win = carry
+        vars8 = _one_round(vars8, k + win[..., 0])
+        win = jnp.concatenate(
+            [win[..., 1:], _schedule_next(win)[..., None]], axis=-1
+        )
+        return (vars8, win), None
+
+    init = (tuple(state[..., i] for i in range(8)), block)
+    (vars8, _), _ = jax.lax.scan(step, init, jnp.asarray(_K_ARR))
+    return jnp.stack([state[..., i] + vars8[i] for i in range(8)], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Nonce templates: host-side message planning
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class NonceTemplate:
+    """A padded SHA-256 message with a nonce-shaped hole.
+
+    ``midstate``: state after the constant prefix blocks (8 u32).
+    ``tail``: the remaining block(s), nonce bytes zeroed ((n, 16) u32).
+    ``positions``: one entry per nonce byte —
+    ``(block, word, word_shift, nonce_shift)`` meaning
+    ``tail[block, word] |= ((nonce >> nonce_shift) & 0xFF) << word_shift``.
+    All entries are Python ints: jitted code closes over them as static
+    constants (this dataclass is hashable ⇒ usable as a jit cache key).
+    ``double``: apply a second SHA-256 over the 32-byte digest (Bitcoin).
+    """
+
+    midstate: Tuple[int, ...]
+    tail: Tuple[Tuple[int, ...], ...]
+    positions: Tuple[Tuple[int, int, int, int], ...]
+    double: bool = False
+
+    @property
+    def n_tail_blocks(self) -> int:
+        return len(self.tail)
+
+    def tail_array(self) -> np.ndarray:
+        return np.array(self.tail, dtype=np.uint32)
+
+    def midstate_array(self) -> np.ndarray:
+        return np.array(self.midstate, dtype=np.uint32)
+
+
+def _pad(message_len: int) -> bytes:
+    """FIPS 180-4 padding for a ``message_len``-byte message."""
+    pad = b"\x80" + b"\x00" * ((55 - message_len) % 64)
+    return pad + struct.pack(">Q", message_len * 8)
+
+
+def _build_template(
+    message_with_hole: bytes,
+    hole_offset: int,
+    byte_map: Sequence[Tuple[int, int]],
+    *,
+    double: bool,
+) -> NonceTemplate:
+    """Plan a template: ``byte_map[j] = (offset_delta, nonce_shift)`` puts
+    ``(nonce >> nonce_shift) & 0xFF`` at ``hole_offset + offset_delta``."""
+    padded = message_with_hole + _pad(len(message_with_hole))
+    assert len(padded) % 64 == 0
+    first_hole_block = min(hole_offset + d for d, _ in byte_map) // 64
+    state = tuple(SHA256_H0)
+    for b in range(first_hole_block):
+        state = sha256_compress(state, padded[b * 64 : (b + 1) * 64])
+    tail_bytes = padded[first_hole_block * 64 :]
+    tail = tuple(
+        struct.unpack(">16I", tail_bytes[b * 64 : (b + 1) * 64])
+        for b in range(len(tail_bytes) // 64)
+    )
+    positions = []
+    for offset_delta, nonce_shift in byte_map:
+        off = hole_offset + offset_delta - first_hole_block * 64
+        positions.append((off // 64, (off % 64) // 4, 24 - 8 * (off % 4), nonce_shift))
+    return NonceTemplate(
+        midstate=state, tail=tail, positions=tuple(positions), double=double
+    )
+
+
+def toy_template(data: bytes) -> NonceTemplate:
+    """Template for the toy dialect: SHA-256(data ‖ nonce_be8), any data
+    length (≡ ``chain.toy_hash``). The 8 big-endian nonce bytes may be
+    unaligned and may straddle a block boundary; the byte map handles
+    both."""
+    message = data + b"\x00" * 8
+    byte_map = [(j, 56 - 8 * j) for j in range(8)]
+    return _build_template(message, len(data), byte_map, double=False)
+
+
+def header_template(header80: bytes) -> NonceTemplate:
+    """Template for Bitcoin: double-SHA-256 over an 80-byte header whose
+    final 4 bytes are the little-endian nonce (≡ ``BlockHeader`` +
+    ``chain.dsha256``). One tail block; midstate covers bytes [0, 64)."""
+    if len(header80) != 80:
+        raise ValueError(f"header must be 80 bytes, got {len(header80)}")
+    message = header80[:76] + b"\x00" * 4
+    byte_map = [(j, 8 * j) for j in range(4)]  # little-endian
+    return _build_template(message, 76, byte_map, double=True)
+
+
+# ---------------------------------------------------------------------------
+# Batched hashing
+# ---------------------------------------------------------------------------
+
+def _inject_nonces(
+    template: NonceTemplate, nonce_hi: jnp.ndarray, nonce_lo: jnp.ndarray
+) -> jnp.ndarray:
+    """Broadcast the tail template over the batch and OR in the nonce
+    bytes at their static positions → ``(N, n_blocks, 16) u32``."""
+    n = nonce_lo.shape[0]
+    tail = jnp.broadcast_to(
+        jnp.asarray(template.tail_array()), (n,) + (template.n_tail_blocks, 16)
+    )
+    for block, word, word_shift, nonce_shift in template.positions:
+        src = nonce_hi if nonce_shift >= 32 else nonce_lo
+        shift = nonce_shift - 32 if nonce_shift >= 32 else nonce_shift
+        byte = (src >> np.uint32(shift)) & np.uint32(0xFF)
+        tail = tail.at[:, block, word].add(byte << np.uint32(word_shift))
+    return tail
+
+
+def sha256_batch(
+    template: NonceTemplate, nonce_hi: jnp.ndarray, nonce_lo: jnp.ndarray
+) -> jnp.ndarray:
+    """Digests for a batch of nonces: ``(N,) u32 × 2 → (N, 8) u32``
+    (digest as big-endian u32 words, i.e. ``struct.unpack('>8I', digest)``).
+
+    Applies the template's second hash when ``template.double``.
+    """
+    n = nonce_lo.shape[0]
+    tail = _inject_nonces(template, nonce_hi, nonce_lo)
+    state = jnp.broadcast_to(jnp.asarray(template.midstate_array()), (n, 8))
+    for b in range(template.n_tail_blocks):
+        state = compress(state, tail[:, b, :])
+    if template.double:
+        # second message: 32-byte digest ‖ 0x80 ‖ zeros ‖ len(256 bits)
+        block2 = jnp.concatenate(
+            [
+                state,
+                jnp.broadcast_to(
+                    jnp.asarray(
+                        np.array(
+                            [0x80000000, 0, 0, 0, 0, 0, 0, 256], dtype=np.uint32
+                        )
+                    ),
+                    (n, 8),
+                ),
+            ],
+            axis=-1,
+        )
+        state = compress(jnp.broadcast_to(jnp.asarray(_H0), (n, 8)), block2)
+    return state
+
+
+def double_sha256_header_batch(
+    template: NonceTemplate, nonces: jnp.ndarray
+) -> jnp.ndarray:
+    """Convenience wrapper for header mining: u32 nonce vector → (N, 8)
+    digest words of double-SHA-256(header with that nonce)."""
+    zeros = jnp.zeros_like(nonces)
+    return sha256_batch(template, zeros, nonces)
+
+
+# ---------------------------------------------------------------------------
+# 256-bit comparisons in u32 lanes
+# ---------------------------------------------------------------------------
+
+def _byteswap32(x: jnp.ndarray) -> jnp.ndarray:
+    return (
+        ((x & np.uint32(0x000000FF)) << np.uint32(24))
+        | ((x & np.uint32(0x0000FF00)) << np.uint32(8))
+        | ((x & np.uint32(0x00FF0000)) >> np.uint32(8))
+        | ((x & np.uint32(0xFF000000)) >> np.uint32(24))
+    )
+
+
+def hash_words_be(digest_words: jnp.ndarray) -> jnp.ndarray:
+    """Digest words → the 256-bit *hash value* as big-endian u32 words,
+    most significant first: Bitcoin interprets the digest as a
+    little-endian integer, so word j = byteswap(digest_word[7-j])."""
+    return _byteswap32(digest_words[..., ::-1])
+
+
+def lex_le(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Lexicographic ``a <= b`` over the last axis (msb-first u32 words);
+    broadcasts, returns bool with the last axis reduced."""
+    lt = jnp.zeros(jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1]), dtype=bool)
+    eq = jnp.ones_like(lt)
+    for k in range(a.shape[-1]):
+        ak, bk = a[..., k], b[..., k]
+        lt = lt | (eq & (ak < bk))
+        eq = eq & (ak == bk)
+    return lt | eq
+
+
+def lex_argmin(words: jnp.ndarray) -> jnp.ndarray:
+    """Index of the lexicographic minimum of ``words (N, W)`` (msb-first
+    u32 words); ties resolve to the lowest index (= lowest nonce, the
+    coordinator's fold order). O(W) min+mask passes — no 64-bit math."""
+    n, w = words.shape
+    mask = jnp.ones((n,), dtype=bool)
+    big = np.uint32(0xFFFFFFFF)
+    for k in range(w):
+        col = jnp.where(mask, words[:, k], big)
+        mask = mask & (col == col.min())
+    return jnp.argmax(mask)
+
+
+# ---------------------------------------------------------------------------
+# Host-side converters
+# ---------------------------------------------------------------------------
+
+def target_to_words(target: int) -> np.ndarray:
+    """256-bit target integer → msb-first u32 words, comparable against
+    :func:`hash_words_be` output with :func:`lex_le`."""
+    if not 0 <= target < 1 << 256:
+        raise ValueError("target out of range")
+    raw = target.to_bytes(32, "big")
+    return np.frombuffer(raw, dtype=">u4").astype(np.uint32)
+
+
+def digest_to_int(digest_words: np.ndarray) -> int:
+    """(8,) digest words → Bitcoin's little-endian uint256 hash value
+    (≡ ``chain.hash_to_int(digest_bytes)``)."""
+    raw = b"".join(struct.pack(">I", int(w)) for w in digest_words)
+    return int.from_bytes(raw, "little")

@@ -1,0 +1,19 @@
+"""Multi-chip scale-out: mesh construction and pod-wide mining sweeps.
+
+The reference scales by running more miner *processes* against the
+coordinator (SURVEY.md §2 parallelism inventory); the TPU rebuild scales
+*within* a worker by sharding the nonce axis across the chips of a slice
+(BASELINE.json:5): ``shard_map`` over a 1-D device mesh, each chip owning
+a contiguous nonce shard, with XLA collectives over ICI for the
+found-flag or-reduce / argmin folds. Across slices (DCN), scale-out goes
+back through the control plane: one worker process per slice, each
+Joining the coordinator like any other miner.
+"""
+
+from tpuminter.parallel.mesh import (
+    build_min_fold,
+    build_target_sweep,
+    make_mesh,
+)
+
+__all__ = ["make_mesh", "build_target_sweep", "build_min_fold"]

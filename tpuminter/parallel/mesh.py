@@ -1,0 +1,186 @@
+"""1-D nonce mesh: shard_map sweeps with ICI collectives (SURVEY.md §7
+stage 5; BASELINE.json:5).
+
+Layout: a sweep covers ``n_batches × batch_per_device`` nonces *per
+device*, and device ``d`` owns the contiguous shard starting at
+``start + d · n_batches · batch_per_device`` — contiguous per chip, as
+the north-star specifies, so a found nonce pins down which chip searched
+what without any gather.
+
+Early exit: a ``lax.while_loop`` steps through batches; each iteration
+ends with a pod-wide **or-reduce of the found flag over ICI**
+(``lax.pmax`` on a u32 flag), so every chip stops within one batch of the
+first sub-target hash anywhere on the pod — no host round-trip in the
+loop. The winner is folded with a ``pmin`` on the winning nonce plus a
+masked ``psum`` to broadcast its digest (disjoint shards ⇒ exactly one
+contributor).
+
+Everything compiles under ``jit`` with static shapes; the same code runs
+on a real TPU slice and on the fake 8-device CPU mesh CI uses
+(tests/conftest.py, ``__graft_entry__.dryrun_multichip``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpuminter.ops import sha256 as ops
+
+__all__ = ["make_mesh", "build_target_sweep", "build_min_fold"]
+
+AXIS = "nonce"
+
+
+def make_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over all (or the given) devices, axis name "nonce"."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (AXIS,))
+
+
+def build_target_sweep(
+    mesh: Mesh,
+    template: ops.NonceTemplate,
+    *,
+    batch_per_device: int,
+    n_batches: int,
+) -> Callable:
+    """Compile a pod-wide TARGET-mode sweep.
+
+    Returns ``sweep(start_u32, target_words_u32x8) -> (found_u32,
+    nonce_u32, digest_words_u32x8, batches_done_u32)`` — replicated
+    scalars/vectors, identical on every chip. ``batches_done`` tells the
+    host how much of the sweep actually ran (early exit) for hash-rate
+    accounting; when nothing is found the digest/nonce outputs are the
+    pod-wide *best effort* (lexicographic-min hash and its nonce), so the
+    worker can still report a min-fold Result.
+    """
+    n_dev = mesh.devices.size
+    per_dev_total = np.uint32(n_batches * batch_per_device)
+
+    def per_device(start: jnp.ndarray, target_words: jnp.ndarray):
+        d = lax.axis_index(AXIS).astype(jnp.uint32)
+        dev_start = start + d * per_dev_total
+
+        def cond(state):
+            b, found, _, _, _ = state
+            return (b < n_batches) & (found == 0)
+
+        def body(state):
+            b, _, _, _, best = state
+            best_words, best_nonce = best
+            nonces = (
+                dev_start
+                + b.astype(jnp.uint32) * np.uint32(batch_per_device)
+                + jnp.arange(batch_per_device, dtype=jnp.uint32)
+            )
+            digests = ops.double_sha256_header_batch(template, nonces)
+            hw = ops.hash_words_be(digests)
+            ok = ops.lex_le(hw, target_words)
+            local_found = ok.any()
+            first = jnp.argmax(ok)
+            # pod-wide or-reduce over ICI: the early-exit signal
+            found = lax.pmax(local_found.astype(jnp.uint32), AXIS)
+            # winner fold: lowest winning nonce wins; its digest comes via
+            # a masked psum (shards are disjoint ⇒ one contributor)
+            cand_nonce = jnp.where(local_found, nonces[first], np.uint32(0xFFFFFFFF))
+            win_nonce = lax.pmin(cand_nonce, AXIS)
+            is_winner = local_found & (cand_nonce == win_nonce)
+            win_digest = lax.psum(
+                jnp.where(is_winner, digests[first], np.uint32(0)), AXIS
+            )
+            # best-effort min fold (for the exhausted case): local lex-min
+            # this batch vs carried best, in hash-value word order
+            midx = ops.lex_argmin(hw)
+            batch_best_words = hw[midx]
+            batch_best_nonce = nonces[midx]
+            keep = ops.lex_le(best_words, batch_best_words)
+            new_best_words = jnp.where(keep, best_words, batch_best_words)
+            new_best_nonce = jnp.where(keep, best_nonce, batch_best_nonce)
+            return (
+                b + 1,
+                found,
+                win_nonce,
+                win_digest,
+                (new_best_words, new_best_nonce),
+            )
+
+        init = (
+            jnp.uint32(0),
+            jnp.uint32(0),
+            jnp.uint32(0xFFFFFFFF),
+            jnp.zeros(8, dtype=jnp.uint32),
+            (jnp.full(8, 0xFFFFFFFF, dtype=jnp.uint32), jnp.uint32(0)),
+        )
+        b, found, win_nonce, win_digest, (best_words, best_nonce) = lax.while_loop(
+            cond, body, init
+        )
+        # exhausted: fold the per-device best across the pod. all_gather
+        # of 8 u32 per chip is trivial ICI traffic; argmin on-replica.
+        all_words = lax.all_gather(best_words, AXIS)      # (n_dev, 8)
+        all_nonces = lax.all_gather(best_nonce, AXIS)     # (n_dev,)
+        bi = ops.lex_argmin(all_words)
+        # hash words (msb-first) → digest words for uniform host decoding
+        fallback_digest = ops.hash_words_be(all_words[bi])
+        nonce_out = jnp.where(found > 0, win_nonce, all_nonces[bi])
+        digest_out = jnp.where(found > 0, win_digest, fallback_digest)
+        return found, nonce_out, digest_out, b
+
+    sharded = jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(), P()),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def build_min_fold(
+    mesh: Mesh,
+    template: ops.NonceTemplate,
+    *,
+    batch_per_device: int,
+) -> Callable:
+    """Compile a pod-wide MIN-mode (toy dialect) batch step.
+
+    Returns ``step(start_hi_u32, start_lo_u32) -> (fold_hi, fold_lo,
+    nonce_hi, nonce_lo)`` — the pod-wide minimum toy fold over
+    ``n_dev × batch_per_device`` consecutive nonces from the 64-bit
+    ``start``, device d owning the contiguous shard
+    ``start + d · batch_per_device``. Host loops this step across a
+    chunk and folds (the toy dialect has no early exit to stop for).
+    """
+
+    def per_device(start_hi: jnp.ndarray, start_lo: jnp.ndarray):
+        d = lax.axis_index(AXIS).astype(jnp.uint32)
+        base_lo = start_lo + d * np.uint32(batch_per_device)
+        carry = (base_lo < start_lo).astype(jnp.uint32)
+        base_hi = start_hi + carry
+        offs = jnp.arange(batch_per_device, dtype=jnp.uint32)
+        lo = base_lo + offs
+        hi = base_hi + (lo < base_lo).astype(jnp.uint32)
+        digests = ops.sha256_batch(template, hi, lo)
+        fold = digests[:, :2]  # (N, 2): toy fold (hi, lo) words
+        idx = ops.lex_argmin(fold)
+        # pod fold: gather each device's (fold, nonce) candidate
+        all_fold = lax.all_gather(fold[idx], AXIS)            # (n_dev, 2)
+        all_hi = lax.all_gather(hi[idx], AXIS)
+        all_lo = lax.all_gather(lo[idx], AXIS)
+        bi = ops.lex_argmin(all_fold)
+        return all_fold[bi][0], all_fold[bi][1], all_hi[bi], all_lo[bi]
+
+    sharded = jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(), P()),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
