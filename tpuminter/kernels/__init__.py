@@ -1,0 +1,17 @@
+"""Hand-written Pallas TPU kernels for the mining hot path.
+
+Same contracts as ``tpuminter.ops`` (the jnp/XLA reference path), but the
+inner loops are Pallas kernels: nonces generated in-register, message
+constants baked into the kernel at trace time via the symbolic compress
+(``tpuminter.ops.symbolic``), digests never touching HBM in the fused
+search. On the CPU backend everything runs in interpreter mode so CI can
+pin kernels to the jnp path bit-for-bit without a TPU (SURVEY.md §4(c)).
+"""
+
+from tpuminter.kernels.sha256 import (
+    pallas_min_toy,
+    pallas_search_target,
+    pallas_sha256_batch,
+)
+
+__all__ = ["pallas_sha256_batch", "pallas_search_target", "pallas_min_toy"]

@@ -1,0 +1,364 @@
+"""Pallas double-SHA-256: the BASELINE.json:5 hot-loop kernel.
+
+Two kernels, both generated from a :class:`~tpuminter.ops.sha256.NonceTemplate`
+via the partial-evaluating symbolic compress (``ops.symbolic``), so every
+message constant — midstate, padding, constant schedule words, constant
+early rounds, ``K+W`` folds — is baked into the instruction stream at
+trace time and the VPU only ever touches nonce-dependent values:
+
+- :func:`pallas_sha256_batch` — digests for an explicit nonce vector
+  (the correctness surface; bit-identical to ``ops.sha256_batch``).
+- :func:`pallas_search_target` — the fused search: nonces are generated
+  *in-register* from a scalar base (zero HBM input traffic), hashed,
+  compared against a baked target, and reduced to one 128-word summary
+  row per grid step (found flag, first-hit index, lexicographic-min hash
+  + argmin for the exhausted fold). Digests never reach HBM.
+
+Layout: work is shaped ``(rows, 128)`` u32 — 8×128 VPU tiles — with the
+grid striding over row blocks. Rotations lower to shift/or pairs
+(pallas_guide: TPUs have no rotate ISA).
+
+On the CPU backend both kernels run in Pallas interpreter mode, letting
+CI validate them without a TPU (SURVEY.md §4(c)).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpuminter.ops import sha256 as ops
+from tpuminter.ops import symbolic as sym
+
+__all__ = ["pallas_sha256_batch", "pallas_search_target"]
+
+LANES = 128
+
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _as_rows(n: int, block_rows: int) -> Tuple[int, int]:
+    if n % (block_rows * LANES) != 0:
+        raise ValueError(
+            f"batch {n} must be a multiple of block_rows*128 = {block_rows * LANES}"
+        )
+    rows = n // LANES
+    return rows, rows // block_rows
+
+
+# ---------------------------------------------------------------------------
+# digests kernel (correctness surface)
+# ---------------------------------------------------------------------------
+
+def _digest_kernel(template, hi_ref, lo_ref, out_ref):
+    digest = sym.double_sha256_sym(template, hi_ref[...], lo_ref[...])
+    for i in range(8):
+        word = digest[i]
+        if isinstance(word, int):  # nonce never reached this word
+            word = jnp.full(hi_ref.shape, word, jnp.uint32)
+        out_ref[i] = word
+
+
+@partial(jax.jit, static_argnums=(0, 3))
+def pallas_sha256_batch(
+    template: ops.NonceTemplate,
+    nonce_hi: jnp.ndarray,
+    nonce_lo: jnp.ndarray,
+    block_rows: int = 8,
+) -> jnp.ndarray:
+    """Digest words for a nonce batch: ``(N,) u32 × 2 → (N, 8) u32``.
+    Drop-in equivalent of ``ops.sha256_batch`` (tests pin them equal)."""
+    n = nonce_lo.shape[0]
+    rows, grid = _as_rows(n, block_rows)
+    out = pl.pallas_call(
+        partial(_digest_kernel, template),
+        out_shape=jax.ShapeDtypeStruct((8, rows, LANES), jnp.uint32),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(
+                (block_rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM
+            )
+        ]
+        * 2,
+        out_specs=pl.BlockSpec(
+            (8, block_rows, LANES), lambda i: (0, i, 0), memory_space=pltpu.VMEM
+        ),
+        interpret=_interpret(),
+    )(nonce_hi.reshape(rows, LANES), nonce_lo.reshape(rows, LANES))
+    return out.transpose(1, 2, 0).reshape(n, 8)
+
+
+# ---------------------------------------------------------------------------
+# fused search kernel (performance surface)
+# ---------------------------------------------------------------------------
+
+#: summary row layout (one 128-lane row per call)
+_FOUND, _FIRST_IDX, _MIN_HW0, _MIN_IDX = 0, 1, 2, 10
+
+_U32MAX = np.uint32(0xFFFFFFFF)
+_I32MAX = np.int32(0x7FFFFFFF)
+_TILE = (8, LANES)  # one VPU tile = 1024 nonces per while-loop step
+
+
+def _bias_const(t: int) -> np.int32:
+    """u32 constant → the sign-biased int32 domain (order-preserving)."""
+    b = int(t) ^ 0x80000000
+    return np.int32(b - (1 << 32) if b >= (1 << 31) else b)
+
+
+def _hash_words_biased(digest):
+    """Digest words → hash-value words (msb-first), sign-biased int32.
+
+    Mosaic has no unsigned reductions/compares; u32 order == i32 order
+    after XOR 0x80000000, so all folding happens in the biased domain.
+    """
+    out = []
+    for j in range(8):
+        word = sym.xor(
+            sym.shl(sym.and_(digest[7 - j], 0x000000FF), 24),
+            sym.shl(sym.and_(digest[7 - j], 0x0000FF00), 8),
+            sym.shr(sym.and_(digest[7 - j], 0x00FF0000), 8),
+            sym.shr(sym.and_(digest[7 - j], 0xFF000000), 24),
+        )
+        out.append(
+            jax.lax.bitcast_convert_type(sym.xor(word, 0x80000000), jnp.int32)
+        )
+    return out
+
+
+def _search_kernel(template, target_words, n_tiles, tiles_per_step,
+                   track_min, n_valid, base_ref, out_ref):
+    """Whole-chunk search in ONE kernel invocation.
+
+    A ``lax.while_loop`` sweeps ``n_tiles`` (8, 128) tiles — 1024 nonces
+    each, ``tiles_per_step`` of them interleaved per iteration so the
+    VPU has independent SHA dependency chains in flight (ILP) — with
+    EARLY EXIT as soon as any step hits the target. A single call covers
+    an arbitrarily large range with zero host syncs mid-sweep (the
+    tunnel-latency killer) while the live register set stays a few tiles
+    wide. All folds are elementwise per lane across tiles; the
+    cross-lane reduction happens once, after the loop.
+    """
+    tgt = [_bias_const(t) for t in target_words]
+    offs = (
+        jax.lax.broadcasted_iota(jnp.int32, _TILE, 0) * np.int32(LANES)
+        + jax.lax.broadcasted_iota(jnp.int32, _TILE, 1)
+    )
+    base = base_ref[0]
+    limit = np.int32(n_valid)
+    tile_sz = _TILE[0] * LANES
+
+    def cond(carry):
+        i, found, _, _ = carry
+        return (i < n_tiles) & (found == 0)
+
+    def body(carry):
+        i, _, first_offs, (min_words, min_offs) = carry
+        any_ok = jnp.zeros(_TILE, jnp.bool_)
+        for t in range(tiles_per_step):
+            offs_i = offs + (i + t) * np.int32(tile_sz)
+            nonces = base + jax.lax.bitcast_convert_type(offs_i, jnp.uint32)
+            # hi nonce half is constant 0 → its bytes fold out
+            digest = sym.double_sha256_sym(template, 0, nonces)
+            hwb = _hash_words_biased(digest)
+            # target compare, lexicographic over baked constants
+            lt = jnp.zeros(_TILE, jnp.bool_)
+            eq = jnp.ones(_TILE, jnp.bool_)
+            for j in range(8):
+                lt = lt | (eq & (hwb[j] < tgt[j]))
+                eq = eq & (hwb[j] == tgt[j])
+            ok = (lt | eq) & (offs_i < limit)  # pad lanes can't win
+            any_ok = any_ok | ok
+            first_offs = jnp.where(
+                ok & (offs_i < first_offs), offs_i, first_offs
+            )
+            if track_min:
+                # elementwise lexicographic min fold vs carried best
+                c_lt = jnp.zeros(_TILE, jnp.bool_)
+                c_eq = jnp.ones(_TILE, jnp.bool_)
+                for j in range(8):
+                    c_lt = c_lt | (c_eq & (hwb[j] < min_words[j]))
+                    c_eq = c_eq & (hwb[j] == min_words[j])
+                c_lt = c_lt & (offs_i < limit)
+                min_words = tuple(
+                    jnp.where(c_lt, hwb[j], min_words[j]) for j in range(8)
+                )
+                min_offs = jnp.where(c_lt, offs_i, min_offs)
+        # one cross-lane reduction per step, not per tile
+        found = jnp.max(any_ok.astype(jnp.int32))
+        return (
+            i + tiles_per_step, found, first_offs, (min_words, min_offs)
+        )
+
+    init = (
+        jnp.int32(0),
+        jnp.int32(0),
+        jnp.full(_TILE, _I32MAX, jnp.int32),
+        (tuple(jnp.full(_TILE, _I32MAX, jnp.int32) for _ in range(8)),
+         jnp.full(_TILE, _I32MAX, jnp.int32)),
+    )
+    _, found, first_offs, (min_words, min_offs) = jax.lax.while_loop(
+        cond, body, init
+    )
+    first = jnp.min(first_offs)
+    # cross-lane lexicographic argmin: 8 min+mask passes, then min-offset
+    # tie-break (= lowest nonce; earlier tiles already won elementwise)
+    mask = jnp.ones(_TILE, jnp.bool_)
+    final_words = []
+    for j in range(8):
+        col = jnp.where(mask, min_words[j], _I32MAX)
+        m = jnp.min(col)
+        mask = mask & (col == m)
+        final_words.append(m)
+    min_idx = jnp.min(jnp.where(mask, min_offs, _I32MAX))
+    # summary row via lane-index select (no scalar scatters); words are
+    # un-biased back to u32 on the way out
+    lane = jax.lax.broadcasted_iota(jnp.int32, _TILE, 1)
+    row = jnp.zeros(_TILE, jnp.int32)
+    for idx, val in (
+        [(_FOUND, found), (_FIRST_IDX, first), (_MIN_IDX, min_idx)]
+        + [(_MIN_HW0 + j, final_words[j] ^ np.int32(-0x80000000))
+           for j in range(8)]
+    ):
+        row = jnp.where(lane == np.int32(idx), val, row)
+    out_ref[...] = jax.lax.bitcast_convert_type(row, jnp.uint32)
+
+
+@partial(jax.jit, static_argnums=(0, 1, 3, 4, 5))
+def pallas_search_target(
+    template: ops.NonceTemplate,
+    target_words: Tuple[int, ...],
+    base: jnp.ndarray,
+    n: int,
+    tiles_per_step: int = 8,
+    track_min: bool = True,
+):
+    """Fused search over up to ``n`` consecutive nonces from scalar
+    ``base`` (``n`` is rounded UP internally to a whole number of loop
+    steps; lanes past the true ``n`` are masked out of every fold, so any
+    ``n >= 1`` is valid).
+
+    Returns ``(found, first_nonce_off, min_hash_words (8,), min_off)``;
+    offsets are relative to ``base``. ``target_words`` are msb-first u32
+    ints (``ops.target_to_words``), static so the compare folds into the
+    kernel. One device call, one host sync, in-kernel early exit: when a
+    hit occurs the loop stops within ``tiles_per_step × 1024`` nonces.
+    ``first_nonce_off`` is exact (the lowest winning offset).
+    """
+    if not 1 <= n <= 1 << 30:
+        raise ValueError("n must be in [1, 2^30] (int32 offset domain)")
+    chunk = _TILE[0] * LANES * tiles_per_step
+    n_tiles = -(-n // chunk) * tiles_per_step  # round up to whole steps
+    summary = pl.pallas_call(
+        partial(_search_kernel, template,
+                tuple(int(t) for t in target_words), n_tiles,
+                tiles_per_step, track_min, n),
+        out_shape=jax.ShapeDtypeStruct(_TILE, jnp.uint32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(base.reshape(1).astype(jnp.uint32))
+    row = summary[0]
+    found = row[_FOUND]
+    first_off = row[_FIRST_IDX]
+    min_words = row[_MIN_HW0 : _MIN_HW0 + 8]
+    min_off = row[_MIN_IDX]
+    return found, first_off, min_words, min_off
+
+
+# ---------------------------------------------------------------------------
+# toy-dialect (MIN) fold kernel
+# ---------------------------------------------------------------------------
+
+def _min_kernel(template, n_tiles, tiles_per_step, n_valid,
+                base_ref, out_ref):
+    """Whole-chunk toy-dialect fold in one invocation: minimize the
+    64-bit fold (digest words 0, 1) over ``n_valid`` consecutive 64-bit
+    nonces. Same tile/ILP structure as the search kernel, no early exit
+    (a min has none)."""
+    offs = (
+        jax.lax.broadcasted_iota(jnp.int32, _TILE, 0) * np.int32(LANES)
+        + jax.lax.broadcasted_iota(jnp.int32, _TILE, 1)
+    )
+    base_hi, base_lo = base_ref[0], base_ref[1]
+    limit = np.int32(n_valid)
+    tile_sz = _TILE[0] * LANES
+
+    def body(i, carry):
+        min_hi, min_lo, min_offs = carry
+        for t in range(tiles_per_step):
+            offs_i = offs + (i + t) * np.int32(tile_sz)
+            lo = base_lo + jax.lax.bitcast_convert_type(offs_i, jnp.uint32)
+            hi = base_hi + (lo < base_lo).astype(jnp.uint32)  # 64-bit carry
+            digest = sym.double_sha256_sym(template, hi, lo)
+            fh = jax.lax.bitcast_convert_type(
+                sym.xor(digest[0], 0x80000000), jnp.int32
+            )
+            fl = jax.lax.bitcast_convert_type(
+                sym.xor(digest[1], 0x80000000), jnp.int32
+            )
+            c_lt = (fh < min_hi) | ((fh == min_hi) & (fl < min_lo))
+            c_lt = c_lt & (offs_i < limit)
+            min_hi = jnp.where(c_lt, fh, min_hi)
+            min_lo = jnp.where(c_lt, fl, min_lo)
+            min_offs = jnp.where(c_lt, offs_i, min_offs)
+        return min_hi, min_lo, min_offs
+
+    init = (
+        jnp.full(_TILE, _I32MAX, jnp.int32),
+        jnp.full(_TILE, _I32MAX, jnp.int32),
+        jnp.full(_TILE, _I32MAX, jnp.int32),
+    )
+    min_hi, min_lo, min_offs = jax.lax.fori_loop(
+        0, n_tiles // tiles_per_step,
+        lambda s, c: body(s * tiles_per_step, c), init
+    )
+    # cross-lane argmin (2 words), lowest-offset tie-break
+    m_hi = jnp.min(min_hi)
+    mask = min_hi == m_hi
+    m_lo = jnp.min(jnp.where(mask, min_lo, _I32MAX))
+    mask = mask & (min_lo == m_lo)
+    m_off = jnp.min(jnp.where(mask, min_offs, _I32MAX))
+    lane = jax.lax.broadcasted_iota(jnp.int32, _TILE, 1)
+    unbias = np.int32(-0x80000000)
+    row = jnp.zeros(_TILE, jnp.int32)
+    for idx, val in ((0, m_hi ^ unbias), (1, m_lo ^ unbias), (2, m_off)):
+        row = jnp.where(lane == np.int32(idx), val, row)
+    out_ref[...] = jax.lax.bitcast_convert_type(row, jnp.uint32)
+
+
+@partial(jax.jit, static_argnums=(0, 3, 4))
+def pallas_min_toy(
+    template: ops.NonceTemplate,
+    base_hi: jnp.ndarray,
+    base_lo: jnp.ndarray,
+    n: int,
+    tiles_per_step: int = 8,
+):
+    """Toy-dialect fold over ``n`` consecutive 64-bit nonces from
+    ``(base_hi, base_lo)``: returns ``(fold_hi, fold_lo, argmin_off)`` —
+    the minimum ``toy_hash`` value as u32 halves and the offset of its
+    nonce. Lanes past ``n`` are masked; ties resolve to the lowest
+    nonce."""
+    if not 1 <= n <= 1 << 30:
+        raise ValueError("n must be in [1, 2^30] (int32 offset domain)")
+    chunk = _TILE[0] * LANES * tiles_per_step
+    n_tiles = -(-n // chunk) * tiles_per_step
+    summary = pl.pallas_call(
+        partial(_min_kernel, template, n_tiles, tiles_per_step, n),
+        out_shape=jax.ShapeDtypeStruct(_TILE, jnp.uint32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(jnp.stack([base_hi.astype(jnp.uint32).reshape(()),
+                 base_lo.astype(jnp.uint32).reshape(())]))
+    row = summary[0]
+    return row[0], row[1], row[2]

@@ -1,0 +1,239 @@
+"""Partially-evaluated SHA-256: compression over a (const | vector) domain.
+
+The mining hot path hashes messages that are constant except for a few
+nonce bytes. Classic miner kernels exploit this with hand-derived
+specializations (midstate reuse, precomputed ``K+W`` for constant
+schedule words, skipping the first rounds of the tail block). This
+module derives ALL of those automatically: values are either Python ints
+(trace-time constants, folded mod 2^32 on the host) or u32 arrays
+(device vectors), and every SHA-256 primitive constant-folds when its
+inputs are constant. Feeding a :class:`~tpuminter.ops.sha256.NonceTemplate`
+through :func:`compress_sym` therefore:
+
+- folds the whole midstate prefix (done once, host-side),
+- folds every schedule word until the first nonce byte enters it,
+- folds the first rounds of the tail block (state stays constant until
+  the first nonce-bearing ``w[i]`` is consumed),
+- folds ``K[i] + w[i]`` into one scalar wherever ``w[i]`` is constant.
+
+The same code serves the jnp path and the Pallas kernels: the array
+branch uses only jnp u32 ops, which lower identically inside a Pallas
+kernel body (VPU shift/or pairs for rotations) and in plain XLA.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpuminter.chain import SHA256_H0, SHA256_K
+
+__all__ = ["Val", "compress_sym", "schedule_word", "inject_nonce_bytes"]
+
+#: A symbolic u32: a Python int (trace-time constant) or a u32 array.
+Val = Union[int, jnp.ndarray]
+
+_M32 = 0xFFFFFFFF
+
+
+def _is_const(x: Val) -> bool:
+    return isinstance(x, int)
+
+
+def add(*xs: Val) -> Val:
+    """Sum mod 2^32, folding all constant terms into one scalar."""
+    const = 0
+    arrays = []
+    for x in xs:
+        if _is_const(x):
+            const = (const + x) & _M32
+        else:
+            arrays.append(x)
+    if not arrays:
+        return const
+    acc = arrays[0]
+    for a in arrays[1:]:
+        acc = acc + a
+    if const:
+        acc = acc + np.uint32(const)
+    return acc
+
+
+def xor(*xs: Val) -> Val:
+    const = 0
+    arrays = []
+    for x in xs:
+        if _is_const(x):
+            const ^= x
+        else:
+            arrays.append(x)
+    if not arrays:
+        return const
+    acc = arrays[0]
+    for a in arrays[1:]:
+        acc = acc ^ a
+    if const:
+        acc = acc ^ np.uint32(const)
+    return acc
+
+
+def and_(a: Val, b: Val) -> Val:
+    if _is_const(a) and _is_const(b):
+        return a & b
+    if _is_const(a):
+        a, b = b, a
+    if _is_const(b):
+        return a & np.uint32(b)
+    return a & b
+
+
+def or_(a: Val, b: Val) -> Val:
+    if _is_const(a) and _is_const(b):
+        return a | b
+    if _is_const(a):
+        a, b = b, a
+    if _is_const(b):
+        return a | np.uint32(b)
+    return a | b
+
+
+def not_(a: Val) -> Val:
+    if _is_const(a):
+        return a ^ _M32
+    return ~a
+
+
+def shr(x: Val, n: int) -> Val:
+    if _is_const(x):
+        return x >> n
+    return x >> np.uint32(n)
+
+
+def shl(x: Val, n: int) -> Val:
+    if _is_const(x):
+        return (x << n) & _M32
+    return x << np.uint32(n)
+
+
+def rotr(x: Val, n: int) -> Val:
+    if _is_const(x):
+        return ((x >> n) | (x << (32 - n))) & _M32
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _sigma0(x: Val) -> Val:
+    return xor(rotr(x, 7), rotr(x, 18), shr(x, 3))
+
+
+def _sigma1(x: Val) -> Val:
+    return xor(rotr(x, 17), rotr(x, 19), shr(x, 10))
+
+
+def _Sigma0(x: Val) -> Val:
+    return xor(rotr(x, 2), rotr(x, 13), rotr(x, 22))
+
+
+def _Sigma1(x: Val) -> Val:
+    return xor(rotr(x, 6), rotr(x, 11), rotr(x, 25))
+
+
+def _ch(e: Val, f: Val, g: Val) -> Val:
+    # g ^ (e & (f ^ g)) ≡ (e & f) ^ (~e & g): one op fewer on the VPU
+    return xor(g, and_(e, xor(f, g)))
+
+
+def _maj(a: Val, b: Val, c: Val) -> Val:
+    # (a & b) ^ (c & (a ^ b)) ≡ majority: one op fewer on the VPU
+    return xor(and_(a, b), and_(c, xor(a, b)))
+
+
+def schedule_word(w: Sequence[Val], i: int) -> Val:
+    """w[i] for i >= 16 from the rolling window."""
+    return add(w[i - 16], _sigma0(w[i - 15]), w[i - 7], _sigma1(w[i - 2]))
+
+
+def compress_sym(state: Sequence[Val], block_w: Sequence[Val]) -> List[Val]:
+    """One SHA-256 compression, fully unrolled, over the symbolic domain.
+
+    ``state`` and ``block_w`` entries may be ints or u32 arrays; the
+    result mixes accordingly. ≡ ``chain.sha256_compress`` when all inputs
+    are ints (used by the tests as a self-check).
+    """
+    w: List[Val] = list(block_w)
+    for i in range(16, 64):
+        w.append(schedule_word(w, i))
+    a, b, c, d, e, f, g, h = state
+    for i in range(64):
+        t1 = add(h, _Sigma1(e), _ch(e, f, g), SHA256_K[i], w[i])
+        t2 = add(_Sigma0(a), _maj(a, b, c))
+        h, g, f, e, d, c, b, a = g, f, e, add(d, t1), c, b, a, add(t1, t2)
+    out = [a, b, c, d, e, f, g, h]
+    return [add(s, v) for s, v in zip(state, out)]
+
+
+def inject_nonce_bytes(
+    tail_block: Sequence[int],
+    positions: Sequence[tuple],
+    block_index: int,
+    nonce_hi: Val,
+    nonce_lo: Val,
+) -> List[Val]:
+    """Build one tail block's schedule words: template constants with the
+    nonce bytes OR'd in at their static positions (the nonce-shaped hole
+    of a ``NonceTemplate``). Words without nonce bytes stay Python ints.
+    """
+    w: List[Val] = list(int(x) for x in tail_block)
+    for blk, word, word_shift, nonce_shift in positions:
+        if blk != block_index:
+            continue
+        src = nonce_hi if nonce_shift >= 32 else nonce_lo
+        shift = nonce_shift - 32 if nonce_shift >= 32 else nonce_shift
+        byte = and_(shr(src, shift), 0xFF)
+        w[word] = or_(w[word], shl(byte, word_shift))
+    return w
+
+
+def hash_sym(
+    midstate: Sequence[Val],
+    tail_blocks: Sequence[Sequence[Val]],
+    positions: Sequence[tuple],
+    double: bool,
+    nonce_hi: Val,
+    nonce_lo: Val,
+) -> List[Val]:
+    """Full symbolic hash: midstate → tail block(s) with injected nonce
+    bytes → optional second hash. Returns the 8 digest words.
+
+    Message values may be Python ints (maximum folding — the baked
+    kernels) or traced u32 *scalars* (one compiled kernel serves every
+    job of the same shape — the production workers); the array branch of
+    every primitive broadcasts scalars against the nonce tiles."""
+    state: List[Val] = list(midstate)
+    for b, block in enumerate(tail_blocks):
+        w: List[Val] = list(block)
+        for blk, word, word_shift, nonce_shift in positions:
+            if blk != b:
+                continue
+            src = nonce_hi if nonce_shift >= 32 else nonce_lo
+            shift = nonce_shift - 32 if nonce_shift >= 32 else nonce_shift
+            byte = and_(shr(src, shift), 0xFF)
+            w[word] = or_(w[word], shl(byte, word_shift))
+        state = compress_sym(state, w)
+    if double:
+        w2: List[Val] = list(state) + [0x80000000, 0, 0, 0, 0, 0, 0, 256]
+        state = compress_sym([int(x) for x in SHA256_H0], w2)
+    return state
+
+
+def double_sha256_sym(template, nonce_hi: Val, nonce_lo: Val) -> List[Val]:
+    """Template wrapper over :func:`hash_sym` with everything constant."""
+    return hash_sym(
+        [int(x) for x in template.midstate],
+        [[int(x) for x in blk] for blk in template.tail],
+        template.positions,
+        template.double,
+        nonce_hi,
+        nonce_lo,
+    )

@@ -1,0 +1,120 @@
+"""TpuMiner: the Pallas-kernel worker (BASELINE.json:5's TPUMiner).
+
+Satisfies the same ``worker.Miner`` generator contract as ``CpuMiner`` /
+``JaxMiner``, but drives the fused Pallas search kernels
+(``tpuminter.kernels``): one device call per slab sweeps up to 2^26
+nonces with in-kernel early exit, so host syncs — expensive through a
+remote-TPU tunnel — happen at slab granularity, and heartbeats/Cancels
+still interleave between slabs.
+
+Requires a TPU backend (the kernels cannot compile on XLA:CPU); the
+worker CLI exposes it as ``--backend tpu``.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpuminter import chain
+from tpuminter.kernels import pallas_min_toy, pallas_search_target
+from tpuminter.ops import sha256 as ops
+from tpuminter.protocol import PowMode, Request, Result
+from tpuminter.worker import Miner
+
+__all__ = ["TpuMiner"]
+
+#: nonces per device call: big enough to amortize tunnel latency, small
+#: enough that a Cancel lands within ~100 ms of work
+DEFAULT_SLAB = 1 << 26
+
+
+class TpuMiner(Miner):
+    """Pallas-kernel miner behind the standard Worker interface."""
+
+    backend = "tpu"
+
+    def __init__(self, slab: int = DEFAULT_SLAB, lanes: Optional[int] = None):
+        if jax.default_backend() == "cpu":
+            raise RuntimeError(
+                "TpuMiner needs a TPU backend (kernels do not compile on "
+                "XLA:CPU); use JaxMiner or CpuMiner instead"
+            )
+        self.slab = slab
+        # scheduler hint: ask for chunks a few slabs deep
+        self.lanes = lanes if lanes is not None else (slab * 4) // 16_384
+
+    def mine(self, request: Request) -> Iterator[Optional[Result]]:
+        if request.mode == PowMode.MIN:
+            yield from self._mine_min(request)
+        else:
+            yield from self._mine_target(request)
+
+    def _slabs(self, lower: int, upper: int):
+        start = lower
+        while start <= upper:
+            take = min(self.slab, upper - start + 1)
+            yield start, take
+            start += take
+
+    def _mine_target(self, req: Request) -> Iterator[Optional[Result]]:
+        assert req.header is not None and req.target is not None
+        template = ops.header_template(req.header)
+        target_words = tuple(int(t) for t in ops.target_to_words(req.target))
+        best: Optional[Tuple[int, int]] = None  # (hash, nonce)
+        searched = 0
+        for start, take in self._slabs(req.lower, req.upper):
+            found, first, min_words, min_off = pallas_search_target(
+                template, target_words, jnp.uint32(start), take
+            )
+            if int(found):
+                nonce = start + int(first)
+                # recompute the winner's hash host-side (one nonce, cheap):
+                # min_words is the slab *minimum*, not necessarily the
+                # first hit the protocol reports
+                h = chain.hash_to_int(
+                    chain.dsha256(req.header[:76] + struct.pack("<I", nonce))
+                )
+                yield Result(
+                    req.job_id, req.mode, nonce, h, found=True,
+                    searched=searched + int(first) + 1, chunk_id=req.chunk_id,
+                )
+                return
+            # min_words are the hash value's u32 words, msb-first — i.e.
+            # the 256-bit hash value itself, big-endian
+            value = 0
+            for w in np.asarray(min_words):
+                value = (value << 32) | int(w)
+            cand = (value, start + int(min_off))
+            if best is None or cand < best:
+                best = cand
+            searched += take
+            yield None
+        yield Result(
+            req.job_id, req.mode, best[1], best[0],
+            found=best[0] <= req.target,
+            searched=searched, chunk_id=req.chunk_id,
+        )
+
+    def _mine_min(self, req: Request) -> Iterator[Optional[Result]]:
+        template = ops.toy_template(req.data)
+        best: Optional[Tuple[int, int]] = None
+        for start, take in self._slabs(req.lower, req.upper):
+            fh, fl, off = pallas_min_toy(
+                template,
+                jnp.uint32(start >> 32),
+                jnp.uint32(start & 0xFFFFFFFF),
+                take,
+            )
+            cand = ((int(fh) << 32) | int(fl), start + int(off))
+            if best is None or cand < best:
+                best = cand
+            yield None
+        yield Result(
+            req.job_id, req.mode, best[1], best[0], found=True,
+            searched=req.upper - req.lower + 1, chunk_id=req.chunk_id,
+        )

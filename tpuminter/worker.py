@@ -165,9 +165,19 @@ async def run_miner(
                 continue
 
             # -- mine, keeping one read in flight for Cancel -------------
+            # Generator steps run in an executor thread: a step may stall
+            # for seconds (device kernel compile, tunnel round-trip) and
+            # must never block the event loop — epoch heartbeats stopping
+            # would get this worker declared dead mid-compile.
+            loop = asyncio.get_running_loop()
+            gen = miner.mine(msg)
             result: Optional[Result] = None
             cancelled = False
-            for item in miner.mine(msg):
+            _done = object()
+            while True:
+                item = await loop.run_in_executor(None, next, gen, _done)
+                if item is _done:
+                    break  # generator ended without a Result
                 if item is not None:
                     result = item
                     break
@@ -182,7 +192,6 @@ async def run_miner(
                         break
                     if inner is not None:
                         pending.put_nowait(inner)
-                await asyncio.sleep(0)  # let the LSP event loop breathe
             if cancelled or result is None:
                 log.info("worker: job %d cancelled mid-chunk", msg.job_id)
                 continue
@@ -213,7 +222,11 @@ def _build_miner(backend: str) -> Miner:
         from tpuminter.jax_worker import JaxMiner
 
         return JaxMiner()
-    raise SystemExit(f"unknown backend {backend!r} (expected cpu|jax)")
+    if backend == "tpu":
+        from tpuminter.tpu_worker import TpuMiner
+
+        return TpuMiner()
+    raise SystemExit(f"unknown backend {backend!r} (expected cpu|jax|tpu)")
 
 
 def main(argv: Optional[list] = None) -> None:
@@ -223,7 +236,7 @@ def main(argv: Optional[list] = None) -> None:
 
     parser = argparse.ArgumentParser(description="tpuminter worker (miner role)")
     parser.add_argument("hostport", help="coordinator address, host:port")
-    parser.add_argument("--backend", default="cpu", help="cpu|jax (default cpu)")
+    parser.add_argument("--backend", default="cpu", help="cpu|jax|tpu (default cpu)")
     args = parser.parse_args(argv)
     host, _, port = args.hostport.rpartition(":")
     logging.basicConfig(level=logging.INFO)
