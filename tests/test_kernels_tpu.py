@@ -22,7 +22,10 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 assert jax.default_backend() != "cpu", f"no TPU: {jax.default_backend()}"
 from tpuminter import chain
 from tpuminter.ops import sha256 as ops
-from tpuminter.kernels import pallas_min_toy, pallas_search_target, pallas_sha256_batch
+from tpuminter.kernels import (
+    pallas_min_toy, pallas_search_candidates, pallas_search_target,
+    pallas_sha256_batch,
+)
 from tpuminter.protocol import PowMode, Request
 from tpuminter.tpu_worker import TpuMiner
 
@@ -60,6 +63,16 @@ wi = min(range(3000), key=lambda i: (tuple(hww[i]), i))
 assert int(f3) == 0 and int(mo3) == wi and (np.asarray(mw3) == hww[wi]).all()
 print("SEARCH-OK")
 
+# --- candidates kernel: find, cap filter, masking ------------------------
+cap1 = jnp.uint32(tw[1])  # diff-1 target word 1 = 0xFFFF0000
+fc, offc = pallas_search_candidates(tmpl, jnp.uint32(gn - 5000), 1 << 14, 8, cap1)
+assert int(fc) == 1 and gn - 5000 + int(offc) == gn
+fc2, _ = pallas_search_candidates(tmpl, jnp.uint32(gn - 5000), 5000, 8, cap1)
+assert int(fc2) == 0  # winner just past the (ragged, masked) limit
+fc3, _ = pallas_search_candidates(tmpl, jnp.uint32(gn - 5000), 1 << 14, 8, jnp.uint32(0))
+assert int(fc3) == 0  # cap=0 rejects genesis (its hash word 1 != 0)
+print("CAND-OK")
+
 # --- toy kernel: 64-bit base, ragged n, exact min ------------------------
 t3 = ops.toy_template(b"kernel min")
 base = (1 << 33) + 7
@@ -87,7 +100,12 @@ assert r.searched == 601
 req2 = Request(job_id=2, mode=PowMode.TARGET, lower=0, upper=999,
                header=chain.GENESIS_HEADER.pack(),
                target=chain.bits_to_target(0x1D00FFFF))
-r2 = drain(miner.mine(req2))
+# fast path: candidate-free exhausted chunk reports the sentinel hash
+r2f = drain(miner.mine(req2))
+assert not r2f.found and r2f.hash_value == (1 << 256) - 1
+assert r2f.searched == 1000
+# exact-min compat path matches the host-side minimum bit-for-bit
+r2 = drain(TpuMiner(slab=1 << 16, exact_min=True).mine(req2))
 want2 = min(
     (chain.hash_to_int(chain.GENESIS_HEADER.with_nonce(i).block_hash()), i)
     for i in range(1000)

@@ -143,3 +143,43 @@ def test_template_is_jit_cache_key():
     step(jnp.zeros(4, dtype=jnp.uint32))
     step(jnp.ones(4, dtype=jnp.uint32))
     assert len(calls) == 1  # traced once
+
+
+def test_e60_e61_early_reject_matches_full_digest():
+    """The candidate kernel's truncated second compression
+    (sym.double_sha256_e60_e61) must agree with the full digest path on
+    words 7 and 6 — the whole soundness argument of the ≥1 GH/s search
+    (a candidate test that missed a winner would silently drop blocks).
+    Includes the genesis winner, whose digest word 7 is 0."""
+    from tpuminter.ops import symbolic as sym
+
+    template = header_template(chain.GENESIS_HEADER.pack())
+    rng = np.random.default_rng(7)
+    nonces = np.concatenate(
+        [[chain.GENESIS_HEADER.nonce], rng.integers(0, 2**32, 1024)]
+    ).astype(np.uint32)
+    nj = jnp.asarray(nonces)
+    e60, e61 = sym.double_sha256_e60_e61(template, 0, nj)
+    digests = np.asarray(double_sha256_header_batch(template, nj))
+    cand = np.asarray(e60) == np.uint32(sym.CAND_E60)
+    assert (cand == (digests[:, 7] == 0)).all()
+    assert cand[0]  # genesis IS a candidate
+    d6 = (np.uint32(sym.DIGEST6_BIAS) + np.asarray(e61)).astype(np.uint32)
+    assert (d6 == digests[:, 6]).all()
+
+
+def test_e60_e61_scalar_constant_folds_to_chain():
+    """With constant nonces the truncated compress folds entirely at
+    trace time; pin it against chain.dsha256's digest words."""
+    from tpuminter.ops import symbolic as sym
+
+    template = header_template(chain.GENESIS_HEADER.pack())
+    for nonce in (0, 1, chain.GENESIS_HEADER.nonce, 0xFFFFFFFF):
+        e60, e61 = sym.double_sha256_e60_e61(template, 0, nonce)
+        assert isinstance(e60, int) and isinstance(e61, int)
+        digest = chain.dsha256(
+            chain.GENESIS_HEADER.with_nonce(nonce).pack()
+        )
+        w7, w6 = struct.unpack(">8I", digest)[7], struct.unpack(">8I", digest)[6]
+        assert (sym.CAND_E60 == e60) == (w7 == 0)
+        assert (sym.DIGEST6_BIAS + e61) & 0xFFFFFFFF == w6

@@ -1,0 +1,143 @@
+"""CandidateSearch driver logic, on a scripted fake device.
+
+The fake reproduces the real kernel's contract exactly — first
+candidate offset in the swept range, early exit, pad-lane quirk — so
+the pipelining/ordering/remainder logic is pinned without a TPU
+(SURVEY.md §4's own-the-seam test idea, applied to the device seam).
+"""
+
+import random
+
+import pytest
+
+from tpuminter.search import CandidateSearch
+
+
+class FakeChip:
+    """Emulates pallas_search_candidates + host verify.
+
+    ``candidates``: sorted nonces whose digest word 7 "is zero".
+    ``winners``: subset that also beats the target.
+    """
+
+    def __init__(self, candidates, winners):
+        self.candidates = sorted(candidates)
+        self.winners = set(winners)
+        assert self.winners <= set(self.candidates)
+        self.sweeps = []  # (base, n) log, dispatch order
+        self.verifies = []
+
+    def sweep(self, base, n):
+        self.sweeps.append((base, n))
+        hit = next(
+            (c for c in self.candidates if base <= c < base + n), None
+        )
+        return (0, 0) if hit is None else (1, hit - base)
+
+    def resolve(self, handle):
+        return handle
+
+    def verify(self, nonce):
+        self.verifies.append(nonce)
+        assert nonce in self.candidates, "verified a non-candidate"
+        # fake hash: winners tiny, losers just above-target
+        return nonce in self.winners, (1 << 200) if nonce in self.winners else (1 << 230)
+
+    def search(self, lower, upper, slab=100, depth=2):
+        s = CandidateSearch(
+            self.sweep, self.resolve, self.verify, lower, upper,
+            slab=slab, depth=depth,
+        )
+        for _ in s.events():
+            pass
+        return s.outcome
+
+
+def test_clean_exhaustion_counts_everything():
+    chip = FakeChip([], [])
+    out = chip.search(0, 999)
+    assert not out.found and out.nonce is None
+    assert out.searched == 1000
+    assert chip.verifies == []
+
+
+def test_true_win_is_exact_and_prunes_later_work():
+    chip = FakeChip([350], [350])
+    out = chip.search(0, 999)
+    assert out.found and out.nonce == 350
+    assert out.hash_value == 1 << 200
+    # pruning: after the win resolves, no new ranges above it are
+    # issued — only calls already in flight (≤ depth of them) may sit
+    # above the winning nonce
+    above = [base for base, _ in chip.sweeps if base > 350]
+    assert len(above) <= 2  # the pipeline depth
+
+
+def test_false_positive_reissues_remainder():
+    chip = FakeChip([50], [])
+    out = chip.search(0, 299)
+    assert not out.found
+    # the remainder [51, 99] was searched despite the early exit —
+    # dispatched as a full slab (single compiled kernel size)
+    assert (51, 100) in chip.sweeps
+    assert out.searched == 300
+    assert out.candidates == [(50, 1 << 230)]
+
+
+def test_win_in_remainder_beats_later_range_win():
+    # A[0,99] false-positives at 50; B[100,199] wins at 150 and resolves
+    # BEFORE the remainder, which holds the true lowest winner at 70.
+    chip = FakeChip([50, 70, 150], [70, 150])
+    out = chip.search(0, 999, slab=100, depth=2)
+    assert out.found and out.nonce == 70
+
+
+def test_later_win_held_until_remainder_clears():
+    # remainder has no candidate: B's win at 150 must still only be
+    # reported after the remainder sweep confirms [51,99] is clean.
+    chip = FakeChip([50, 150], [150])
+    out = chip.search(0, 999, slab=100, depth=2)
+    assert out.found and out.nonce == 150
+    assert (51, 100) in chip.sweeps  # remainder was actually swept
+
+
+def test_exhausted_best_is_min_candidate():
+    chip = FakeChip([20, 80], [])
+    out = chip.search(0, 99, slab=10)
+    assert not out.found
+    assert out.best == (1 << 230, 20)
+    assert out.searched == 100
+
+
+def test_pad_lane_hit_past_range_is_clean_cover():
+    class PadChip(FakeChip):
+        def sweep(self, base, n):
+            self.sweeps.append((base, n))
+            return (1, n + 7)  # fired past the real range
+
+    chip = PadChip([], [])
+    out = chip.search(0, 999)
+    assert not out.found and out.searched == 1000
+    assert chip.verifies == []
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_randomized_matches_bruteforce(seed):
+    rng = random.Random(seed)
+    lower, upper = 0, rng.randrange(200, 2000)
+    space = range(lower, upper + 1)
+    candidates = sorted(rng.sample(space, rng.randrange(0, 12)))
+    winners = [c for c in candidates if rng.random() < 0.4]
+    chip = FakeChip(candidates, winners)
+    out = chip.search(
+        lower, upper,
+        slab=rng.choice([37, 100, 256, 4096]),
+        depth=rng.choice([1, 2, 3]),
+    )
+    if winners:
+        assert out.found and out.nonce == min(winners)
+    else:
+        assert not out.found
+        assert out.searched == upper - lower + 1
+        if candidates:
+            assert out.best == (1 << 230, min(candidates))

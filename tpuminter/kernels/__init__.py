@@ -10,8 +10,14 @@ pin kernels to the jnp path bit-for-bit without a TPU (SURVEY.md §4(c)).
 
 from tpuminter.kernels.sha256 import (
     pallas_min_toy,
+    pallas_search_candidates,
     pallas_search_target,
     pallas_sha256_batch,
 )
 
-__all__ = ["pallas_sha256_batch", "pallas_search_target", "pallas_min_toy"]
+__all__ = [
+    "pallas_sha256_batch",
+    "pallas_search_target",
+    "pallas_search_candidates",
+    "pallas_min_toy",
+]

@@ -1,6 +1,6 @@
-"""Pallas double-SHA-256: the BASELINE.json:5 hot-loop kernel.
+"""Pallas double-SHA-256: the BASELINE.json:5 hot-loop kernels.
 
-Two kernels, both generated from a :class:`~tpuminter.ops.sha256.NonceTemplate`
+All generated from a :class:`~tpuminter.ops.sha256.NonceTemplate`
 via the partial-evaluating symbolic compress (``ops.symbolic``), so every
 message constant — midstate, padding, constant schedule words, constant
 early rounds, ``K+W`` folds — is baked into the instruction stream at
@@ -8,18 +8,26 @@ trace time and the VPU only ever touches nonce-dependent values:
 
 - :func:`pallas_sha256_batch` — digests for an explicit nonce vector
   (the correctness surface; bit-identical to ``ops.sha256_batch``).
-- :func:`pallas_search_target` — the fused search: nonces are generated
-  *in-register* from a scalar base (zero HBM input traffic), hashed,
-  compared against a baked target, and reduced to one 128-word summary
-  row per grid step (found flag, first-hit index, lexicographic-min hash
-  + argmin for the exhausted fold). Digests never reach HBM.
+- :func:`pallas_search_candidates` — the PRODUCTION search: nonces are
+  generated *in-register* from a scalar base (zero HBM input traffic)
+  and early-rejected on the hash's top 64 bits only, two rounds short
+  of a full second compression (``sym.compress_sym_e60_e61``); rare
+  survivors are verified host-side (``tpuminter.search``). This is the
+  ≥1 GH/s/chip path.
+- :func:`pallas_search_target` — full in-kernel 256-bit target compare
+  plus the running lexicographic-min fold (exact exhausted-range
+  minimum); slower, used when exact-min semantics are required.
 
-Layout: work is shaped ``(rows, 128)`` u32 — 8×128 VPU tiles — with the
-grid striding over row blocks. Rotations lower to shift/or pairs
+Layout: work arrays are ``(32, 128)`` u32 tiles (see ``_TILE``) with a
+``lax.while_loop`` striding tiles and ``tiles_per_step`` independent
+dependency chains in flight. Rotations lower to shift/or pairs
 (pallas_guide: TPUs have no rotate ISA).
 
-On the CPU backend both kernels run in Pallas interpreter mode, letting
-CI validate them without a TPU (SURVEY.md §4(c)).
+The kernels set ``interpret=True`` on the CPU backend, but the unrolled
+~6k-op bodies make interpreter-mode execution impractically slow beyond
+tiny shapes; CPU CI pins the *generator* (``ops.symbolic``) against the
+jnp path instead, and tests/test_kernels_tpu.py exercises the compiled
+kernels on a real chip (see that module's rationale).
 """
 
 from __future__ import annotations
@@ -36,7 +44,11 @@ from jax.experimental.pallas import tpu as pltpu
 from tpuminter.ops import sha256 as ops
 from tpuminter.ops import symbolic as sym
 
-__all__ = ["pallas_sha256_batch", "pallas_search_target"]
+__all__ = [
+    "pallas_sha256_batch",
+    "pallas_search_target",
+    "pallas_search_candidates",
+]
 
 LANES = 128
 
@@ -105,7 +117,12 @@ _FOUND, _FIRST_IDX, _MIN_HW0, _MIN_IDX = 0, 1, 2, 10
 
 _U32MAX = np.uint32(0xFFFFFFFF)
 _I32MAX = np.int32(0x7FFFFFFF)
-_TILE = (8, LANES)  # one VPU tile = 1024 nonces per while-loop step
+#: work-array shape per "tile": 32 sublane rows × 128 lanes = 4096 nonces.
+#: Taller-than-vreg tiles (4 native (8,128) vregs per op) measurably beat
+#: 8-row tiles on v5e (~+8% GH/s): each traced op covers 4× the work, so
+#: the unrolled SHA body has 4× fewer instructions to fetch/schedule,
+#: while `tiles_per_step` still provides independent dependency chains.
+_TILE = (32, LANES)
 
 
 def _bias_const(t: int) -> np.int32:
@@ -138,8 +155,8 @@ def _search_kernel(template, target_words, n_tiles, tiles_per_step,
                    track_min, n_valid, base_ref, out_ref):
     """Whole-chunk search in ONE kernel invocation.
 
-    A ``lax.while_loop`` sweeps ``n_tiles`` (8, 128) tiles — 1024 nonces
-    each, ``tiles_per_step`` of them interleaved per iteration so the
+    A ``lax.while_loop`` sweeps ``n_tiles`` ``_TILE``-shaped tiles — 4096
+    nonces each, ``tiles_per_step`` of them interleaved per iteration so the
     VPU has independent SHA dependency chains in flight (ILP) — with
     EARLY EXIT as soon as any step hits the target. A single call covers
     an arbitrarily large range with zero host syncs mid-sweep (the
@@ -250,7 +267,7 @@ def pallas_search_target(
     offsets are relative to ``base``. ``target_words`` are msb-first u32
     ints (``ops.target_to_words``), static so the compare folds into the
     kernel. One device call, one host sync, in-kernel early exit: when a
-    hit occurs the loop stops within ``tiles_per_step × 1024`` nonces.
+    hit occurs the loop stops within ``tiles_per_step × 4096`` nonces.
     ``first_nonce_off`` is exact (the lowest winning offset).
     """
     if not 1 <= n <= 1 << 30:
@@ -272,6 +289,124 @@ def pallas_search_target(
     min_words = row[_MIN_HW0 : _MIN_HW0 + 8]
     min_off = row[_MIN_IDX]
     return found, first_off, min_words, min_off
+
+
+# ---------------------------------------------------------------------------
+# candidate kernel: the production TARGET hot path
+# ---------------------------------------------------------------------------
+
+def _cand_kernel(template, n_tiles, tiles_per_step, n_valid, mask_tail,
+                 base_ref, cap_ref, out_ref):
+    """Early-reject sweep: find the first offset whose double-SHA hash
+    value's top 64 bits clear the bar — word 0 (byteswapped digest word
+    7) must be ZERO (necessary for every real target) and word 1
+    (byteswapped digest word 6) must be ≤ a *dynamic* cap carried in
+    SMEM (the target's second word — dynamic so one compiled kernel
+    serves every difficulty). Per nonce this computes only ``(e60,
+    e61)`` of the second compression (``sym.double_sha256_e60_e61``),
+    one equality against the baked :data:`sym.CAND_E60`, and one
+    biased compare; no final adds, no 256-bit compare, no min fold —
+    full evaluation happens host-side for the rare survivors. With the
+    cap at the target's real word 1 the false-survivor rate is ~2^-64,
+    so sweeps essentially never early-exit without a true win.
+    Tail-lane masking is emitted only when ``n`` is not a whole number
+    of steps (``mask_tail``), keeping the hot loop free of it for
+    power-of-two slabs."""
+    cand_c = np.uint32(sym.CAND_E60)
+    offs = (
+        jax.lax.broadcasted_iota(jnp.int32, _TILE, 0) * np.int32(LANES)
+        + jax.lax.broadcasted_iota(jnp.int32, _TILE, 1)
+    )
+    base = base_ref[0]
+    # hash word 1 cap: pre-biased into the signed-compare domain on the
+    # host (Mosaic has no scalar bitcast)
+    cap1 = cap_ref[0]
+    limit = np.int32(n_valid)
+    tile_sz = _TILE[0] * LANES
+
+    def cond(carry):
+        i, found, _ = carry
+        return (i < n_tiles) & (found == 0)
+
+    def body(carry):
+        i, _, first_offs = carry
+        any_ok = jnp.zeros(_TILE, jnp.bool_)
+        for t in range(tiles_per_step):
+            offs_i = offs + (i + t) * np.int32(tile_sz)
+            nonces = base + jax.lax.bitcast_convert_type(offs_i, jnp.uint32)
+            e60, e61 = sym.double_sha256_e60_e61(template, 0, nonces)
+            digest6 = sym.add(sym.DIGEST6_BIAS, e61)
+            hw1 = sym.xor(
+                sym.shl(sym.and_(digest6, 0x000000FF), 24),
+                sym.shl(sym.and_(digest6, 0x0000FF00), 8),
+                sym.shr(sym.and_(digest6, 0x00FF0000), 8),
+                sym.shr(sym.and_(digest6, 0xFF000000), 24),
+                0x80000000,
+            )
+            hw1b = jax.lax.bitcast_convert_type(hw1, jnp.int32)
+            ok = (e60 == cand_c) & (hw1b <= cap1)
+            if mask_tail:
+                ok = ok & (offs_i < limit)
+            any_ok = any_ok | ok
+            first_offs = jnp.where(
+                ok & (offs_i < first_offs), offs_i, first_offs
+            )
+        found = jnp.max(any_ok.astype(jnp.int32))
+        return (i + tiles_per_step, found, first_offs)
+
+    init = (jnp.int32(0), jnp.int32(0), jnp.full(_TILE, _I32MAX, jnp.int32))
+    _, found, first_offs = jax.lax.while_loop(cond, body, init)
+    first = jnp.min(first_offs)
+    lane = jax.lax.broadcasted_iota(jnp.int32, _TILE, 1)
+    row = jnp.where(lane == np.int32(_FOUND), found, jnp.zeros(_TILE, jnp.int32))
+    row = jnp.where(lane == np.int32(_FIRST_IDX), first, row)
+    out_ref[...] = jax.lax.bitcast_convert_type(row, jnp.uint32)
+
+
+@partial(jax.jit, static_argnums=(0, 2, 3))
+def pallas_search_candidates(
+    template: ops.NonceTemplate,
+    base: jnp.ndarray,
+    n: int,
+    tiles_per_step: int = 8,
+    hw1_cap: jnp.ndarray | None = None,
+):
+    """Fast sweep of ``n`` consecutive nonces from scalar ``base`` for
+    *candidates*: nonces whose double-SHA-256 hash value has top word
+    zero AND second word ≤ ``hw1_cap`` (a dynamic u32 scalar — pass the
+    target's word 1 so a candidate is a true win up to a ~2^-64
+    tail; defaults to 0xFFFFFFFF, i.e. the pure top-word-zero test).
+    Top word zero is a necessary condition for ``hash <= target`` at
+    every real difficulty (the Bitcoin target's top word is 0 from
+    difficulty 1 up), so the sweep can never miss a winner.
+
+    Returns ``(found, first_off)``: ``found != 0`` iff a candidate lies
+    in range, ``first_off`` its lowest offset from ``base``. The kernel
+    early-exits within ``tiles_per_step × 4096`` nonces of a candidate;
+    offsets past the first candidate are NOT searched (the caller owns
+    host-side verification + remainder re-issue —
+    ``tpuminter.search.CandidateSearch``). The hot loop carries no
+    byteswap/256-bit-compare/min-fold baggage — full evaluation happens
+    host-side for the rare survivors."""
+    if not 1 <= n <= 1 << 30:
+        raise ValueError("n must be in [1, 2^30] (int32 offset domain)")
+    if hw1_cap is None:
+        hw1_cap = jnp.uint32(0xFFFFFFFF)
+    chunk = _TILE[0] * LANES * tiles_per_step
+    n_tiles = -(-n // chunk) * tiles_per_step
+    cap_biased = jax.lax.bitcast_convert_type(
+        hw1_cap.astype(jnp.uint32) ^ jnp.uint32(0x80000000), jnp.int32
+    )
+    summary = pl.pallas_call(
+        partial(_cand_kernel, template, n_tiles, tiles_per_step, n,
+                n % chunk != 0),
+        out_shape=jax.ShapeDtypeStruct(_TILE, jnp.uint32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * 2,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(base.reshape(1).astype(jnp.uint32), cap_biased.reshape(1))
+    row = summary[0]
+    return row[_FOUND], row[_FIRST_IDX]
 
 
 # ---------------------------------------------------------------------------

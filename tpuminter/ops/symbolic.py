@@ -23,14 +23,23 @@ kernel body (VPU shift/or pairs for rotations) and in plain XLA.
 
 from __future__ import annotations
 
-from typing import List, Sequence, Union
+from typing import List, Sequence, Tuple, Union
 
 import jax.numpy as jnp
 import numpy as np
 
 from tpuminter.chain import SHA256_H0, SHA256_K
 
-__all__ = ["Val", "compress_sym", "schedule_word", "inject_nonce_bytes"]
+__all__ = [
+    "Val",
+    "compress_sym",
+    "schedule_word",
+    "inject_nonce_bytes",
+    "compress_sym_e60_e61",
+    "double_sha256_e60_e61",
+    "CAND_E60",
+    "DIGEST6_BIAS",
+]
 
 #: A symbolic u32: a Python int (trace-time constant) or a u32 array.
 Val = Union[int, jnp.ndarray]
@@ -171,6 +180,71 @@ def compress_sym(state: Sequence[Val], block_w: Sequence[Val]) -> List[Val]:
         h, g, f, e, d, c, b, a = g, f, e, add(d, t1), c, b, a, add(t1, t2)
     out = [a, b, c, d, e, f, g, h]
     return [add(s, v) for s, v in zip(state, out)]
+
+
+def compress_sym_e60_e61(
+    state: Sequence[Val], block_w: Sequence[Val]
+) -> Tuple[Val, Val]:
+    """Truncated compression: the ``e`` values after rounds 60 and 61.
+
+    The classic miner early-reject (VERDICT.md round-1 #2), one word
+    deeper: final digest word 7 is ``state[7] + e_60`` (``h_64 = g_63 =
+    f_62 = e_61``, i.e. the ``e`` produced at round 60) and digest word
+    6 is ``state[6] + e_61`` — so a candidate test over the hash's top
+    64 bits stops 2 rounds early. Round ``i``'s ``e`` reads the ``a``
+    produced at round ``i-4``, so rounds 58-61 skip the whole
+    ``a``-chain (Σ0 + maj + add), and the message schedule stops at
+    ``w[61]``. Relative to :func:`compress_sym` that drops 2 full
+    rounds, 4 ``t2`` computations, 2 schedule words, the 8 final state
+    adds — and lets the caller skip the remaining byteswaps and the
+    256-bit compare entirely.
+    """
+    w: List[Val] = list(block_w)
+    for i in range(16, 62):
+        w.append(schedule_word(w, i))
+    a, b, c, d, e, f, g, h = state
+    e60: Val = 0
+    for i in range(58):
+        t1 = add(h, _Sigma1(e), _ch(e, f, g), SHA256_K[i], w[i])
+        t2 = add(_Sigma0(a), _maj(a, b, c))
+        h, g, f, e, d, c, b, a = g, f, e, add(d, t1), c, b, a, add(t1, t2)
+    for i in range(58, 62):
+        # e_i = a_{i-4} + t1_i: the a-chain beyond round 57 is dead, so
+        # new ``a`` values are dummies (0) that nothing ever reads.
+        t1 = add(h, _Sigma1(e), _ch(e, f, g), SHA256_K[i], w[i])
+        h, g, f, e, d, c, b, a = g, f, e, add(d, t1), c, b, a, 0
+        if i == 60:
+            e60 = e
+    return e60, e
+
+
+#: ``e60 == CAND_E60``  ⟺  digest word 7 == 0  ⟺  the top 32 bits of the
+#: 256-bit hash value are zero — a *necessary* condition for beating any
+#: target whose top word is 0 (every real Bitcoin difficulty ≥ 1).
+CAND_E60: int = (-SHA256_H0[7]) & _M32
+
+#: digest word 6 (whose byteswap is hash word 1) = ``DIGEST6_BIAS + e61``
+DIGEST6_BIAS: int = SHA256_H0[6]
+
+
+def double_sha256_e60_e61(
+    template, nonce_hi: Val, nonce_lo: Val
+) -> Tuple[Val, Val]:
+    """``(e60, e61)`` of the second compression for a double-SHA
+    template: the minimal computation deciding the hash's top 64 bits
+    (digest word 7 == 0 via :data:`CAND_E60`; hash word 1 =
+    byteswap(:data:`DIGEST6_BIAS` + e61)). First hash runs in full (its
+    digest feeds the second block); the second stops at round 61."""
+    if not template.double:
+        raise ValueError("e60 early-reject only applies to double-SHA templates")
+    state: List[Val] = [int(x) for x in template.midstate]
+    for b, block in enumerate(template.tail):
+        w = inject_nonce_bytes(
+            [int(x) for x in block], template.positions, b, nonce_hi, nonce_lo
+        )
+        state = compress_sym(state, w)
+    w2: List[Val] = list(state) + [0x80000000, 0, 0, 0, 0, 0, 0, 256]
+    return compress_sym_e60_e61([int(x) for x in SHA256_H0], w2)
 
 
 def inject_nonce_bytes(

@@ -40,7 +40,17 @@ __all__ = [
     "encode_msg",
     "decode_msg",
     "ProtocolError",
+    "MIN_UNTRACKED",
 ]
+
+#: Sentinel ``hash_value`` in an exhausted TARGET Result from a worker
+#: that does not track the running 256-bit minimum (the fast TPU path
+#: skips it to hit ≥1 GH/s). Loses every min-fold against a real hash,
+#: so mixed fleets degrade gracefully; a final Result carrying it means
+#: "range exhausted, no winner, minimum untracked" — consumers must not
+#: present it as a real hash (the client CLI already prints a plain
+#: "Exhausted" line for found=False).
+MIN_UNTRACKED = (1 << 256) - 1
 
 
 class ProtocolError(ValueError):
@@ -107,6 +117,8 @@ class Result:
     for MIN mode, the uint256 little-endian integer of the double-SHA
     digest for TARGET mode — and ``nonce`` its argmin. ``found`` is True
     in MIN mode always, in TARGET mode iff ``hash_value <= target``.
+    Workers that don't track the exhausted-range minimum (the fast TPU
+    path) report :data:`MIN_UNTRACKED` instead of a real minimum.
     ``searched`` is the number of nonces actually examined (less than the
     range size when a TARGET hit early-exits a chunk); the coordinator's
     final Result to the client carries the job-wide total. ``chunk_id``

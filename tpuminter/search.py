@@ -1,0 +1,191 @@
+"""Pipelined candidate search: the production TARGET-mode driver.
+
+The fast kernel (``kernels.pallas_search_candidates``) returns only a
+*candidate* — the first nonce in a swept range whose double-SHA digest
+word 7 is zero (top 32 hash bits zero). That design moves everything
+rare off the device: full-hash evaluation, the target compare, and the
+decision to keep searching all happen host-side, once per ~2^32 hashes.
+This module owns the host half:
+
+- **Pipelining.** Device calls are issued ``depth`` deep before the
+  first result is read, so the per-call host/tunnel dispatch latency
+  (~50-100 ms through a remote-TPU link) overlaps device compute.
+  Measured on v5e: 0.73 GH/s synchronous → ≥1.0 GH/s pipelined.
+- **Verification.** A candidate is verified host-side against the real
+  target (``chain.dsha256``); the kernel's necessary-condition test has
+  a ~1-per-2^32 false-positive rate at real difficulties.
+- **Remainder re-issue.** A call that reports a candidate early-exited:
+  offsets past the candidate are unsearched. On a false positive the
+  remainder range is pushed to the *front* of the work queue.
+- **Ordered acceptance.** A verified win W is only accepted once every
+  nonce below W has been searched, so the reported winner is exactly
+  the lowest winning nonce in the range — the same contract as the
+  sequential CPU miner (SURVEY.md §3.2's loop semantics).
+
+The driver is deliberately generic over three callables (``sweep``,
+``resolve``, ``verify``) so its queueing/ordering logic is testable on
+CPU with a scripted fake device (tests/test_search.py) and reusable by
+both the single-chip TpuMiner and the bench harness.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, List, Optional, Tuple
+
+__all__ = ["CandidateSearch", "SearchOutcome"]
+
+#: sweep(base, n) -> opaque handle (asynchronous dispatch)
+SweepFn = Callable[[int, int], object]
+#: resolve(handle) -> (found, first_off); blocks until the call is done
+ResolveFn = Callable[[object], Tuple[int, int]]
+#: verify(nonce) -> (wins, hash_value) — full host-side evaluation
+VerifyFn = Callable[[int], Tuple[bool, int]]
+
+
+@dataclass
+class SearchOutcome:
+    """Terminal state of a :class:`CandidateSearch` run."""
+
+    found: bool
+    nonce: Optional[int] = None
+    hash_value: Optional[int] = None
+    searched: int = 0
+    #: every candidate surfaced (nonce, hash) — at exhaustion their min
+    #: is the exact range minimum *iff* any candidate existed
+    candidates: List[Tuple[int, int]] = field(default_factory=list)
+
+    @property
+    def best(self) -> Optional[Tuple[int, int]]:
+        """(hash, nonce) minimum over surfaced candidates, or None."""
+        if not self.candidates:
+            return None
+        return min((h, n) for n, h in self.candidates)
+
+
+class CandidateSearch:
+    """Exact lowest-winner search over ``[lower, upper]`` (inclusive).
+
+    ``slab`` nonces per device call, ``depth`` calls in flight. Drive it
+    with :meth:`events` — a generator yielding ``None`` after every
+    resolved call (a natural heartbeat/Cancel point for the worker
+    loop); when it stops, :attr:`outcome` is set.
+    """
+
+    def __init__(
+        self,
+        sweep: SweepFn,
+        resolve: ResolveFn,
+        verify: VerifyFn,
+        lower: int,
+        upper: int,
+        *,
+        slab: int = 1 << 27,
+        depth: int = 2,
+    ):
+        if not 0 <= lower <= upper < 1 << 32:
+            raise ValueError(f"bad range [{lower}, {upper}]")
+        if not 1 <= slab <= 1 << 30:
+            raise ValueError("slab must be in [1, 2^30]")
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self._sweep, self._resolve, self._verify = sweep, resolve, verify
+        self.lower, self.upper = lower, upper
+        self.slab, self.depth = slab, depth
+        # disjoint unsearched ranges; ascending except re-queued
+        # remainders, which go to the FRONT (they are always lower than
+        # anything else still queued — see _on_candidate)
+        self._pending: deque = deque([(lower, upper)])
+        self._inflight: deque = deque()  # (start, end, handle) FIFO
+        self._wins: List[Tuple[int, int]] = []  # (nonce, hash)
+        self.outcome: Optional[SearchOutcome] = None
+        self._searched = 0
+        self._candidates: List[Tuple[int, int]] = []
+
+    @property
+    def searched(self) -> int:
+        """Nonces verifiably swept so far (early exits count only their
+        covered prefix) — the honest throughput numerator."""
+        return self._searched
+
+    # -- internals --------------------------------------------------------
+
+    def _issue_one(self) -> None:
+        start, end = self._pending.popleft()
+        take = min(self.slab, end - start + 1)
+        if start + take - 1 < end:
+            self._pending.appendleft((start + take, end))
+        # ALWAYS dispatch a full slab, even when the logical range is
+        # shorter (trailing chunk, post-candidate remainder): the kernel
+        # specializes on n at compile time, so a single canonical n means
+        # a single compile for the whole mining session — a fresh slab
+        # size mid-run costs ~20 s of XLA through the tunnel. Sound
+        # because the kernel reports the LOWEST candidate offset: a hit
+        # past ``end`` (or past 2^32 wrap) proves [start, end] clean.
+        self._inflight.append((start, start + take - 1, self._sweep(start, self.slab)))
+
+    def _unsearched_min(self) -> Optional[int]:
+        starts = [s for s, _ in self._pending]
+        starts += [s for s, _, _ in self._inflight]
+        return min(starts) if starts else None
+
+    def _try_finish(self) -> bool:
+        if not self._wins:
+            if self._pending or self._inflight:
+                return False
+            self.outcome = SearchOutcome(
+                found=False, searched=self._searched,
+                candidates=self._candidates,
+            )
+            return True
+        w_nonce, w_hash = min(self._wins)
+        lo = self._unsearched_min()
+        if lo is not None and lo < w_nonce:
+            return False
+        self.outcome = SearchOutcome(
+            found=True, nonce=w_nonce, hash_value=w_hash,
+            searched=self._searched, candidates=self._candidates,
+        )
+        return True
+
+    def _prune_pending_above(self, nonce: int) -> None:
+        """Ranges entirely above a verified win can never beat it."""
+        self._pending = deque(
+            (s, e) for s, e in self._pending if s < nonce
+        )
+
+    # -- driver -----------------------------------------------------------
+
+    def events(self) -> Iterator[None]:
+        """Run to completion; yields after each resolved device call."""
+        while True:
+            while len(self._inflight) < self.depth and self._pending:
+                self._issue_one()
+            if not self._inflight:
+                assert self._try_finish(), "no work left but not finished"
+                return
+            start, end, handle = self._inflight.popleft()
+            found, off = self._resolve(handle)
+            n = end - start + 1
+            if not found or off >= n:
+                # clean sweep: no candidate at any offset within the
+                # logical range (a hit past it — oversweep slack or a pad
+                # lane — still proves every lower offset candidate-free)
+                self._searched += n
+            else:
+                cand = start + off
+                self._searched += off + 1
+                if cand < end:
+                    # early exit skipped the rest: search it before
+                    # anything later (front of queue keeps nonce order)
+                    self._pending.appendleft((cand + 1, end))
+                wins, hash_value = self._verify(cand)
+                self._candidates.append((cand, hash_value))
+                if wins:
+                    self._wins.append((cand, hash_value))
+                    self._prune_pending_above(cand)
+            if self._try_finish():
+                yield
+                return
+            yield

@@ -2,10 +2,30 @@
 
 Satisfies the same ``worker.Miner`` generator contract as ``CpuMiner`` /
 ``JaxMiner``, but drives the fused Pallas search kernels
-(``tpuminter.kernels``): one device call per slab sweeps up to 2^26
-nonces with in-kernel early exit, so host syncs — expensive through a
-remote-TPU tunnel — happen at slab granularity, and heartbeats/Cancels
-still interleave between slabs.
+(``tpuminter.kernels``).
+
+TARGET jobs run the **candidate pipeline** (``tpuminter.search``): the
+device sweeps slabs for nonces whose top 32 hash bits are zero — the
+cheapest necessary condition for any real difficulty — with ``depth``
+calls in flight so the remote-TPU tunnel's per-dispatch latency
+overlaps compute (the difference between ~0.7 and ≥1.0 GH/s on v5e),
+and the host verifies the ~1-per-2^32 candidates exactly. Heartbeats
+and Cancels interleave at slab-resolution granularity.
+
+The pipeline does not track the running 256-bit minimum (that is what
+makes it fast), so an exhausted TARGET chunk reports the exact range
+minimum only when the range contained a candidate (their min *is* the
+range min when one exists — any hash with a nonzero top word loses to
+every candidate); otherwise it reports ``protocol.MIN_UNTRACKED`` with
+``found=False``. The sentinel loses every coordinator min-fold against
+a real value, so mixed CPU/TPU fleets still surface a real best; in an
+all-fast-TPU fleet over candidate-free ranges (the common case for
+ranges ≪ 2^32) the final exhausted Result carries the sentinel, which
+the protocol documents as "minimum untracked" and the client renders
+as a plain Exhausted line — it is never presented as a real hash.
+Construct with ``exact_min=True`` to use the slower tracking kernel
+(``pallas_search_target``) and match CpuMiner's exhausted-min output
+bit-for-bit.
 
 Requires a TPU backend (the kernels cannot compile on XLA:CPU); the
 worker CLI exposes it as ``--backend tpu``.
@@ -21,16 +41,59 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpuminter import chain
-from tpuminter.kernels import pallas_min_toy, pallas_search_target
+from tpuminter.kernels import (
+    pallas_min_toy,
+    pallas_search_candidates,
+    pallas_search_target,
+)
 from tpuminter.ops import sha256 as ops
-from tpuminter.protocol import PowMode, Request, Result
+from tpuminter.protocol import MIN_UNTRACKED, PowMode, Request, Result
+from tpuminter.search import CandidateSearch
 from tpuminter.worker import Miner
 
-__all__ = ["TpuMiner"]
+__all__ = ["TpuMiner", "make_header_search"]
 
-#: nonces per device call: big enough to amortize tunnel latency, small
-#: enough that a Cancel lands within ~100 ms of work
-DEFAULT_SLAB = 1 << 26
+#: nonces per device call: 2^27 ≈ 130 ms on v5e — big enough that the
+#: pipelined tunnel dispatch amortizes (≥1 GH/s sustained from depth 2),
+#: small enough that a Cancel lands within ~2 slabs
+DEFAULT_SLAB = 1 << 27
+
+#: device calls kept in flight (measured: 2 suffices to hide dispatch)
+DEFAULT_DEPTH = 2
+
+
+def make_header_search(header80: bytes, target: int, tiles_per_step: int = 8):
+    """The production sweep/resolve/verify triple for a header-mining
+    job, shared by TpuMiner and the bench harness (so the benchmark
+    measures exactly the shipping code path):
+
+    - ``sweep(base, n)`` dispatches the candidate kernel with the
+      target's hash-word-1 cap baked in dynamically (candidates are
+      true wins up to a ~2^-64 tail, so early exits are never wasted),
+    - ``resolve(handle)`` syncs a call's (found, first_off),
+    - ``verify(nonce)`` re-hashes host-side and applies the exact
+      256-bit target compare.
+    """
+    template = ops.header_template(header80)
+    header76 = header80[:76]
+    hw1_cap = jnp.uint32(int(ops.target_to_words(target)[1]))
+
+    def sweep(base: int, n: int):
+        return pallas_search_candidates(
+            template, jnp.uint32(base), n, tiles_per_step, hw1_cap
+        )
+
+    def resolve(handle):
+        found, off = handle
+        return int(found), int(off)
+
+    def verify(nonce: int) -> Tuple[bool, int]:
+        h = chain.hash_to_int(
+            chain.dsha256(header76 + struct.pack("<I", nonce))
+        )
+        return h <= target, h
+
+    return sweep, resolve, verify
 
 
 class TpuMiner(Miner):
@@ -38,21 +101,31 @@ class TpuMiner(Miner):
 
     backend = "tpu"
 
-    def __init__(self, slab: int = DEFAULT_SLAB, lanes: Optional[int] = None):
+    def __init__(
+        self,
+        slab: int = DEFAULT_SLAB,
+        lanes: Optional[int] = None,
+        depth: int = DEFAULT_DEPTH,
+        exact_min: bool = False,
+    ):
         if jax.default_backend() == "cpu":
             raise RuntimeError(
                 "TpuMiner needs a TPU backend (kernels do not compile on "
                 "XLA:CPU); use JaxMiner or CpuMiner instead"
             )
         self.slab = slab
+        self.depth = depth
+        self.exact_min = exact_min
         # scheduler hint: ask for chunks a few slabs deep
         self.lanes = lanes if lanes is not None else (slab * 4) // 16_384
 
     def mine(self, request: Request) -> Iterator[Optional[Result]]:
         if request.mode == PowMode.MIN:
             yield from self._mine_min(request)
+        elif self.exact_min or not _fast_path_ok(request.target):
+            yield from self._mine_target_tracking(request)
         else:
-            yield from self._mine_target(request)
+            yield from self._mine_target_fast(request)
 
     def _slabs(self, lower: int, upper: int):
         start = lower
@@ -61,7 +134,34 @@ class TpuMiner(Miner):
             yield start, take
             start += take
 
-    def _mine_target(self, req: Request) -> Iterator[Optional[Result]]:
+    # -- TARGET: candidate pipeline (production path) ---------------------
+
+    def _mine_target_fast(self, req: Request) -> Iterator[Optional[Result]]:
+        assert req.header is not None and req.target is not None
+        sweep, resolve, verify = make_header_search(req.header, req.target)
+        search = CandidateSearch(
+            sweep, resolve, verify, req.lower, req.upper,
+            slab=self.slab, depth=self.depth,
+        )
+        for _ in search.events():
+            yield None  # heartbeat / Cancel window per resolved slab
+        out = search.outcome
+        if out.found:
+            yield Result(
+                req.job_id, req.mode, out.nonce, out.hash_value,
+                found=True, searched=out.searched, chunk_id=req.chunk_id,
+            )
+            return
+        best = out.best  # exact range min iff any candidate surfaced
+        hash_value, nonce = best if best else (MIN_UNTRACKED, req.lower)
+        yield Result(
+            req.job_id, req.mode, nonce, hash_value, found=False,
+            searched=out.searched, chunk_id=req.chunk_id,
+        )
+
+    # -- TARGET: exact-min tracking kernel (compat path) ------------------
+
+    def _mine_target_tracking(self, req: Request) -> Iterator[Optional[Result]]:
         assert req.header is not None and req.target is not None
         template = ops.header_template(req.header)
         target_words = tuple(int(t) for t in ops.target_to_words(req.target))
@@ -100,6 +200,8 @@ class TpuMiner(Miner):
             searched=searched, chunk_id=req.chunk_id,
         )
 
+    # -- MIN (toy) dialect ------------------------------------------------
+
     def _mine_min(self, req: Request) -> Iterator[Optional[Result]]:
         template = ops.toy_template(req.data)
         best: Optional[Tuple[int, int]] = None
@@ -118,3 +220,10 @@ class TpuMiner(Miner):
             req.job_id, req.mode, best[1], best[0], found=True,
             searched=req.upper - req.lower + 1, chunk_id=req.chunk_id,
         )
+
+
+def _fast_path_ok(target: Optional[int]) -> bool:
+    """The candidate test (top 32 hash bits zero) is *necessary* only
+    when the target's top word is zero — true for every real Bitcoin
+    difficulty (≥1). Toy targets above 2^224 take the tracking kernel."""
+    return target is not None and target < 1 << 224
