@@ -102,8 +102,12 @@ def bench_time_to_block() -> dict:
         for _ in s.events():
             pass
         dt = time.perf_counter() - t0
-        assert s.outcome.found and s.outcome.nonce == g, "wrong block!"
-        assert s.outcome.hash_value == chain.GENESIS_HEADER.block_hash_int()
+        # any verified winner in the window counts (ADVICE.md r2: the
+        # genesis nonce is the expected winner, but a second diff-1
+        # winner below it in the window would also be a correct block)
+        assert s.outcome.found, "no block found in a window known to contain one"
+        won, h = verify(s.outcome.nonce)
+        assert won and h == s.outcome.hash_value <= target, "unverifiable winner"
         return dt
 
     cold = run()  # first call at this n: includes compile

@@ -26,14 +26,17 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import struct
 import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, Dict, Optional, Tuple
 
+from tpuminter import chain
 from tpuminter.lsp import LspServer, Params
 from tpuminter.lsp.params import FAST
 from tpuminter.protocol import (
+    MIN_UNTRACKED,
     Cancel,
     Join,
     PowMode,
@@ -54,6 +57,12 @@ log = logging.getLogger("tpuminter.coordinator")
 DEFAULT_CHUNK_SIZE = 16_384
 
 
+#: unverifiable Results tolerated per miner before it is evicted — bounds
+#: the requeue ping-pong a deterministically-buggy backend could otherwise
+#: sustain forever against its own rejected chunk
+MAX_REJECTIONS = 3
+
+
 @dataclass
 class _MinerState:
     conn_id: int
@@ -64,6 +73,7 @@ class _MinerState:
     #: it answers: after a Cancel races a completion, a stale Result must
     #: not clobber the miner's next assignment.
     chunk: Optional[Tuple[int, int, int, int]] = None
+    rejections: int = 0
 
 
 @dataclass
@@ -101,7 +111,12 @@ class Coordinator:
         self._next_job_id = 1
         self._next_chunk_id = 1
         #: cumulative (hashes searched, jobs finished) — observability (§5)
-        self.stats = {"hashes": 0, "jobs_done": 0, "chunks_requeued": 0}
+        self.stats = {
+            "hashes": 0,
+            "jobs_done": 0,
+            "chunks_requeued": 0,
+            "results_rejected": 0,
+        }
 
     @classmethod
     async def create(
@@ -166,10 +181,7 @@ class Coordinator:
                 job = self._jobs.get(job_id)
                 if job is not None and not job.done:
                     job.inflight.pop(conn_id, None)
-                    job.ranges.appendleft((lo, hi))
-                    if job_id not in self._rotation:
-                        self._rotation.append(job_id)
-                    self.stats["chunks_requeued"] += 1
+                    self._requeue_chunk(job, lo, hi)
                     log.info(
                         "miner %d died; requeued [%d, %d] of job %d",
                         conn_id, lo, hi, job_id,
@@ -183,6 +195,10 @@ class Coordinator:
             for job_id in list(job_ids):
                 self._abandon_job(job_id)
             log.info("client %d died; dropped jobs %s", conn_id, sorted(job_ids))
+            # abandoning marked the dead client's cancelled miners idle;
+            # other clients' queued jobs must not wait for an unrelated
+            # event to claim them (ADVICE.md r1)
+            self._dispatch()
 
     # -- job lifecycle ---------------------------------------------------
 
@@ -215,13 +231,39 @@ class Coordinator:
         if miner.chunk is None or miner.chunk[0] != msg.chunk_id:
             # stale: answers a dispatch we already cancelled/requeued. The
             # miner's current assignment (if any) is still being mined —
-            # leave it untouched.
+            # leave it untouched, but give idle miners a chance at queued
+            # work before returning (ADVICE.md r1: returning early here
+            # could strand queued jobs until an unrelated event).
+            self._dispatch()
             return
         _, job_id, lo, hi = miner.chunk
         miner.chunk = None
         job = self._jobs.get(job_id)
         if job is not None and not job.done:
             job.inflight.pop(conn_id, None)
+            if not self._verify_result(job.request, msg):
+                # one buggy/malicious backend must not corrupt the fold or
+                # report a wrong winner to the client (ADVICE.md r1): drop
+                # the claim, requeue the chunk for an honest worker.
+                log.warning(
+                    "miner %d returned an unverifiable result for job %d "
+                    "(nonce=%d); chunk [%d, %d] requeued",
+                    conn_id, job_id, msg.nonce, lo, hi,
+                )
+                self.stats["results_rejected"] += 1
+                self._requeue_chunk(job, lo, hi)
+                miner.rejections += 1
+                if miner.rejections >= MAX_REJECTIONS:
+                    # a backend that keeps producing garbage would ping-
+                    # pong its own rejected chunk forever: evict it.
+                    log.warning(
+                        "miner %d evicted after %d unverifiable results",
+                        conn_id, miner.rejections,
+                    )
+                    self._miners.pop(conn_id, None)
+                    self._server.close_conn(conn_id)
+                self._dispatch()
+                return
             searched = msg.searched if msg.searched > 0 else hi - lo + 1
             job.hashes_done += searched
             self.stats["hashes"] += searched
@@ -235,6 +277,38 @@ class Coordinator:
                 )
                 self._finish_job(job, found=found)
         self._dispatch()
+
+    def _requeue_chunk(self, job: _Job, lo: int, hi: int) -> None:
+        """Return a chunk to the front of its job's queue (the shared
+        path for miner death and rejected results)."""
+        job.ranges.appendleft((lo, hi))
+        if job.job_id not in self._rotation:
+            self._rotation.append(job.job_id)
+        self.stats["chunks_requeued"] += 1
+
+    @staticmethod
+    def _verify_result(req: Request, msg: Result) -> bool:
+        """Host-side spot-check of a chunk Result (ADVICE.md r1).
+
+        The claimed hash must be the true hash of the claimed nonce (one
+        host hash — cheap at chunk granularity), and a ``found=True``
+        TARGET claim must actually beat the target. A worker can still
+        under-search its range, but it cannot forge a winner or poison
+        the min fold with a value no nonce produces.
+        """
+        if not msg.found and msg.hash_value == MIN_UNTRACKED:
+            return True  # fast-path sentinel: no claim to verify
+        try:
+            if req.mode == PowMode.MIN:
+                return chain.toy_hash(req.data, msg.nonce) == msg.hash_value
+            h = chain.hash_to_int(
+                chain.dsha256(req.header[:76] + struct.pack("<I", msg.nonce))
+            )
+        except (struct.error, TypeError, OverflowError):
+            return False
+        if h != msg.hash_value:
+            return False
+        return not msg.found or h <= (req.target or 0)
 
     def _finish_job(self, job: _Job, *, found: bool) -> None:
         job.done = True

@@ -71,6 +71,14 @@ class CandidateSearch:
     with :meth:`events` — a generator yielding ``None`` after every
     resolved call (a natural heartbeat/Cancel point for the worker
     loop); when it stops, :attr:`outcome` is set.
+
+    Contract note (ADVICE.md r2): when a verified win ends the search,
+    up to ``depth - 1`` in-flight sweep handles above the winner are
+    simply **abandoned, never resolved**. That is free for JAX async
+    arrays (the device work is already dispatched and the result is
+    garbage-collected), but a ``resolve`` callable that owns real
+    resources per handle must tolerate dropped handles — clean them up
+    in a finalizer, not in ``resolve``.
     """
 
     def __init__(

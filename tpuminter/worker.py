@@ -214,8 +214,19 @@ def _safe_decode(raw: bytes) -> Optional[Message]:
         return None
 
 
-def _build_miner(backend: str) -> Miner:
-    """Backend registry for the CLI; device backends import lazily."""
+def _build_miner(
+    backend: str,
+    *,
+    exact_min: bool = False,
+    slab: Optional[int] = None,
+    depth: Optional[int] = None,
+) -> Miner:
+    """Backend registry for the CLI; device backends import lazily.
+
+    ``exact_min``/``slab``/``depth`` tune the TPU backend (ADVICE.md r2:
+    fleets needing CpuMiner-compatible exhausted-range minima opt in via
+    ``--exact-min``); the other backends ignore them.
+    """
     if backend == "cpu":
         return CpuMiner()
     if backend == "jax":
@@ -225,7 +236,12 @@ def _build_miner(backend: str) -> Miner:
     if backend == "tpu":
         from tpuminter.tpu_worker import TpuMiner
 
-        return TpuMiner()
+        kwargs = {"exact_min": exact_min}
+        if slab is not None:
+            kwargs["slab"] = slab
+        if depth is not None:
+            kwargs["depth"] = depth
+        return TpuMiner(**kwargs)
     raise SystemExit(f"unknown backend {backend!r} (expected cpu|jax|tpu)")
 
 
@@ -237,10 +253,26 @@ def main(argv: Optional[list] = None) -> None:
     parser = argparse.ArgumentParser(description="tpuminter worker (miner role)")
     parser.add_argument("hostport", help="coordinator address, host:port")
     parser.add_argument("--backend", default="cpu", help="cpu|jax|tpu (default cpu)")
+    parser.add_argument(
+        "--exact-min", action="store_true",
+        help="tpu backend: track the exact exhausted-range minimum "
+        "(CpuMiner-compatible) at reduced throughput",
+    )
+    parser.add_argument(
+        "--slab", type=int, default=None,
+        help="tpu backend: nonces per device call (default 2^27)",
+    )
+    parser.add_argument(
+        "--depth", type=int, default=None,
+        help="tpu backend: device calls kept in flight (default 2)",
+    )
     args = parser.parse_args(argv)
     host, _, port = args.hostport.rpartition(":")
     logging.basicConfig(level=logging.INFO)
-    asyncio.run(run_miner(host or "127.0.0.1", int(port), _build_miner(args.backend)))
+    miner = _build_miner(
+        args.backend, exact_min=args.exact_min, slab=args.slab, depth=args.depth
+    )
+    asyncio.run(run_miner(host or "127.0.0.1", int(port), miner))
 
 
 if __name__ == "__main__":
