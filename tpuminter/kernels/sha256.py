@@ -48,6 +48,7 @@ __all__ = [
     "pallas_sha256_batch",
     "pallas_search_target",
     "pallas_search_candidates",
+    "pallas_search_candidates_hdr",
 ]
 
 LANES = 128
@@ -405,6 +406,118 @@ def pallas_search_candidates(
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         interpret=_interpret(),
     )(base.reshape(1).astype(jnp.uint32), cap_biased.reshape(1))
+    row = summary[0]
+    return row[_FOUND], row[_FIRST_IDX]
+
+
+# ---------------------------------------------------------------------------
+# dynamic-header candidate kernel (the extranonce-roll consumer)
+# ---------------------------------------------------------------------------
+
+def _cand_hdr_kernel(n_tiles, tiles_per_step, n_valid, mask_tail,
+                     mid_ref, tw_ref, base_ref, cap_ref, out_ref):
+    """Early-reject sweep over a header whose midstate and variable tail
+    words arrive in SMEM at *runtime* instead of being baked at trace
+    time: the consumer of the on-device extranonce roll
+    (``ops.merkle.make_extranonce_roll`` → this kernel, zero host
+    round-trips per roll, BASELINE.json:9-10) — and, as a bonus, a
+    single compiled kernel that serves EVERY header-mining job (no
+    ~20-40 s per-job XLA compile through the remote-TPU tunnel).
+
+    Identical candidate test to ``_cand_kernel``; the only cost of
+    dynamism is the partial-eval folds the symbolic compress can no
+    longer do (the first tail compression's early rounds and its
+    constant-word ``K+W`` folds), a few percent of the instruction
+    stream."""
+    mid = [mid_ref[i] for i in range(8)]
+    tail = [tw_ref[0], tw_ref[1], tw_ref[2], 0] + list(ops.HEADER_TAIL_PAD)
+    cand_c = np.uint32(sym.CAND_E60)
+    offs = (
+        jax.lax.broadcasted_iota(jnp.int32, _TILE, 0) * np.int32(LANES)
+        + jax.lax.broadcasted_iota(jnp.int32, _TILE, 1)
+    )
+    base = base_ref[0]
+    cap1 = cap_ref[0]
+    limit = np.int32(n_valid)
+    tile_sz = _TILE[0] * LANES
+
+    def cond(carry):
+        i, found, _ = carry
+        return (i < n_tiles) & (found == 0)
+
+    def body(carry):
+        i, _, first_offs = carry
+        any_ok = jnp.zeros(_TILE, jnp.bool_)
+        for t in range(tiles_per_step):
+            offs_i = offs + (i + t) * np.int32(tile_sz)
+            nonces = base + jax.lax.bitcast_convert_type(offs_i, jnp.uint32)
+            e60, e61 = sym.hash_sym_e60_e61(
+                mid, [tail], ops.HEADER_NONCE_POSITIONS, 0, nonces
+            )
+            digest6 = sym.add(sym.DIGEST6_BIAS, e61)
+            hw1 = sym.xor(
+                sym.shl(sym.and_(digest6, 0x000000FF), 24),
+                sym.shl(sym.and_(digest6, 0x0000FF00), 8),
+                sym.shr(sym.and_(digest6, 0x00FF0000), 8),
+                sym.shr(sym.and_(digest6, 0xFF000000), 24),
+                0x80000000,
+            )
+            hw1b = jax.lax.bitcast_convert_type(hw1, jnp.int32)
+            ok = (e60 == cand_c) & (hw1b <= cap1)
+            if mask_tail:
+                ok = ok & (offs_i < limit)
+            any_ok = any_ok | ok
+            first_offs = jnp.where(
+                ok & (offs_i < first_offs), offs_i, first_offs
+            )
+        found = jnp.max(any_ok.astype(jnp.int32))
+        return (i + tiles_per_step, found, first_offs)
+
+    init = (jnp.int32(0), jnp.int32(0), jnp.full(_TILE, _I32MAX, jnp.int32))
+    _, found, first_offs = jax.lax.while_loop(cond, body, init)
+    first = jnp.min(first_offs)
+    lane = jax.lax.broadcasted_iota(jnp.int32, _TILE, 1)
+    row = jnp.where(lane == np.int32(_FOUND), found, jnp.zeros(_TILE, jnp.int32))
+    row = jnp.where(lane == np.int32(_FIRST_IDX), first, row)
+    out_ref[...] = jax.lax.bitcast_convert_type(row, jnp.uint32)
+
+
+@partial(jax.jit, static_argnums=(3, 4))
+def pallas_search_candidates_hdr(
+    midstate8: jnp.ndarray,
+    tailw3: jnp.ndarray,
+    base: jnp.ndarray,
+    n: int,
+    tiles_per_step: int = 8,
+    hw1_cap: jnp.ndarray | None = None,
+):
+    """Dynamic-header twin of :func:`pallas_search_candidates`: the
+    header midstate (8 u32) and variable tail words (merkle word 7,
+    time, bits) are runtime device values — pass the outputs of
+    ``ops.merkle.make_extranonce_roll`` straight in; they never visit
+    the host. Same return contract: ``(found, first_off)``."""
+    if not 1 <= n <= 1 << 30:
+        raise ValueError("n must be in [1, 2^30] (int32 offset domain)")
+    if hw1_cap is None:
+        hw1_cap = jnp.uint32(0xFFFFFFFF)
+    chunk = _TILE[0] * LANES * tiles_per_step
+    n_tiles = -(-n // chunk) * tiles_per_step
+    cap_biased = jax.lax.bitcast_convert_type(
+        hw1_cap.astype(jnp.uint32) ^ jnp.uint32(0x80000000), jnp.int32
+    )
+    summary = pl.pallas_call(
+        partial(_cand_hdr_kernel, n_tiles, tiles_per_step, n,
+                n % chunk != 0),
+        out_shape=jax.ShapeDtypeStruct(_TILE, jnp.uint32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * 4,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(
+        midstate8.astype(jnp.uint32),
+        tailw3.astype(jnp.uint32),
+        base.reshape(1).astype(jnp.uint32),
+        cap_biased.reshape(1),
+    )
     row = summary[0]
     return row[_FOUND], row[_FIRST_IDX]
 

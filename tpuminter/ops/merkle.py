@@ -1,0 +1,127 @@
+"""On-device extranonce → merkle-root → header-midstate roll.
+
+The BASELINE.json:9-10 capability: when a worker exhausts the 32-bit
+header nonce space it bumps the coinbase extranonce, which changes the
+coinbase txid, which changes the merkle root, which changes the header —
+and therefore the SHA midstate the hot search kernels specialize on.
+The reference has no analogue (its toy PoW has no headers); stratum
+miners do this on the host. Here the whole chain
+
+    extranonce → coinbase txid → branch fold → merkle root
+               → header midstate + variable tail words
+
+runs as ONE jitted device program (:func:`make_extranonce_roll`), so a
+>2^32 search never ships header bytes from the host: the roll's
+``(midstate, tail_words)`` outputs stay on device and feed either the
+jnp dynamic-header hash (``ops.sha256.header_digest_dyn``) or the
+dynamic Pallas candidate kernel
+(``kernels.pallas_search_candidates_hdr``) directly.
+
+Cost: ``3 + 3·len(branch)`` SHA-256 compressions per extranonce — per
+2^32 nonces of search, i.e. ~1e-9 of the hot-loop work.
+
+Host reference semantics: ``chain.rolled_header`` /
+``chain.CoinbaseTemplate`` (tests pin the device roll bit-equal).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpuminter.chain import HEADER_SIZE, SHA256_H0
+from tpuminter.ops import sha256 as ops
+
+__all__ = ["make_extranonce_roll"]
+
+_H0 = np.array(SHA256_H0, dtype=np.uint32)
+#: FIPS padding block for a 64-byte message (the merkle pair hash)
+_PAD512 = np.array([0x80000000] + [0] * 14 + [512], dtype=np.uint32)
+#: second-hash block words 8..15 for a 32-byte digest message
+_PAD256 = np.array([0x80000000, 0, 0, 0, 0, 0, 0, 256], dtype=np.uint32)
+
+
+def _dsha256_pair(left8: jnp.ndarray, right8: jnp.ndarray) -> jnp.ndarray:
+    """Double SHA-256 of the 64-byte concatenation of two 32-byte hashes
+    given as (8,) u32 big-endian word vectors — one merkle tree edge."""
+    h0 = jnp.asarray(_H0)
+    state = ops.compress(h0, jnp.concatenate([left8, right8]))
+    state = ops.compress(state, jnp.asarray(_PAD512))
+    return ops.compress(h0, jnp.concatenate([state, jnp.asarray(_PAD256)]))
+
+
+def make_extranonce_roll(
+    header80: bytes,
+    coinbase_prefix: bytes,
+    coinbase_suffix: bytes,
+    extranonce_size: int,
+    branch: Sequence[bytes],
+) -> Callable[[jnp.ndarray, jnp.ndarray], Tuple[jnp.ndarray, jnp.ndarray]]:
+    """Compile the device roll for one job.
+
+    Returns ``roll(en_hi_u32, en_lo_u32) -> (midstate (8,) u32,
+    tail_words (3,) u32)``: the SHA-256 state after the rolled header's
+    first 64 bytes, and the header tail words ``(merkle word 7, time,
+    bits)`` — exactly what ``ops.header_digest_dyn`` and the dynamic
+    Pallas kernel consume. ``header80``'s merkle-root field is ignored
+    (it is what the roll recomputes); version/prev/time/bits are baked
+    as constants. ≡ ``ops.header_template(chain.rolled_header(...).
+    pack())``'s ``midstate``/``tail_words()`` for every extranonce
+    (pinned by tests/test_extranonce.py).
+    """
+    if len(header80) != HEADER_SIZE:
+        raise ValueError(f"header must be {HEADER_SIZE} bytes, got {len(header80)}")
+    if not 1 <= extranonce_size <= 8:
+        raise ValueError("extranonce_size must be in [1, 8]")
+    for sib in branch:
+        if len(sib) != 32:
+            raise ValueError("merkle branch entries must be 32 bytes")
+
+    # coinbase txid as a NonceTemplate: the extranonce is the "nonce
+    # hole" (little-endian bytes at the prefix/suffix seam), so all the
+    # midstate/partial-eval machinery applies to the coinbase hash too
+    cb_message = coinbase_prefix + b"\x00" * extranonce_size + coinbase_suffix
+    cb_template = ops._build_template(
+        cb_message,
+        len(coinbase_prefix),
+        [(j, 8 * j) for j in range(extranonce_size)],
+        double=True,
+    )
+    branch_words = [
+        jnp.asarray(np.frombuffer(sib, dtype=">u4").astype(np.uint32))
+        for sib in branch
+    ]
+    # header constants: words 0..8 of block 1 (version ‖ prev_hash) and
+    # the time/bits tail words — big-endian u32 reads of the serialized
+    # bytes, merkle-root bytes excluded
+    hdr_head9 = jnp.asarray(
+        np.frombuffer(header80[:36], dtype=">u4").astype(np.uint32)
+    )
+    w_time, w_bits = struct.unpack(">2I", header80[68:76])
+    time_bits = jnp.asarray(np.array([w_time, w_bits], dtype=np.uint32))
+
+    @jax.jit
+    def roll(en_hi: jnp.ndarray, en_lo: jnp.ndarray):
+        txid = ops.sha256_batch(
+            cb_template, en_hi.reshape(1).astype(jnp.uint32),
+            en_lo.reshape(1).astype(jnp.uint32),
+        )[0]  # (8,) coinbase txid words (big-endian u32 of txid bytes)
+        node = txid
+        for sib in branch_words:
+            # coinbase is leaf 0: the running node is always the LEFT
+            # input at every level (index path all zeros)
+            node = _dsha256_pair(node, sib)
+        # merkle root bytes land in the header verbatim (internal byte
+        # order == digest byte order), so root words ARE header words:
+        # block 1 = version ‖ prev_hash ‖ root[0:28]
+        midstate = ops.compress(
+            jnp.asarray(_H0), jnp.concatenate([hdr_head9, node[:7]])
+        )
+        tail_words = jnp.concatenate([node[7:8], time_bits])
+        return midstate, tail_words
+
+    return roll

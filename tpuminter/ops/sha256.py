@@ -44,6 +44,9 @@ __all__ = [
     "header_template",
     "sha256_batch",
     "double_sha256_header_batch",
+    "HEADER_NONCE_POSITIONS",
+    "HEADER_TAIL_PAD",
+    "header_digest_dyn",
     "hash_words_be",
     "lex_le",
     "lex_argmin",
@@ -292,6 +295,77 @@ def double_sha256_header_batch(
     digest words of double-SHA-256(header with that nonce)."""
     zeros = jnp.zeros_like(nonces)
     return sha256_batch(template, zeros, nonces)
+
+
+# ---------------------------------------------------------------------------
+# Dynamic header hashing (the on-device extranonce-roll consumer)
+# ---------------------------------------------------------------------------
+
+#: nonce byte positions in an 80-byte header's tail block: little-endian
+#: u32 at bytes 76..80, i.e. word 3 of the second block (what
+#: ``header_template`` computes; pinned by tests against it)
+HEADER_NONCE_POSITIONS: Tuple[Tuple[int, int, int, int], ...] = (
+    (0, 3, 24, 0),
+    (0, 3, 16, 8),
+    (0, 3, 8, 16),
+    (0, 3, 0, 24),
+)
+
+#: constant schedule words 4..15 of an 80-byte header's tail block
+#: (FIPS 180-4 padding for an 80-byte message: 0x80 then the 640-bit len)
+HEADER_TAIL_PAD: Tuple[int, ...] = (0x80000000, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 640)
+
+
+def header_digest_dyn(
+    midstate8: jnp.ndarray, tailw3: jnp.ndarray, nonces: jnp.ndarray
+) -> jnp.ndarray:
+    """Double-SHA-256 digests for a header whose midstate and variable
+    tail words are *runtime values* (u32 arrays of shape (8,) and (3,)),
+    not trace-time constants: ``(N,) u32 nonces → (N, 8) digest words``.
+
+    This is the hash the on-device extranonce roll feeds
+    (``ops.merkle.make_extranonce_roll`` produces exactly this
+    ``(midstate, tail_words)`` pair from an extranonce, BASELINE.json:
+    9-10): one compiled program serves every extranonce — and every
+    header-mining job — because nothing job-specific is baked in.
+    ``tailw3`` is ``(merkle_root word 7, time word, bits word)``, the
+    three header tail words before the nonce. ≡ ``double_sha256_header_
+    batch(header_template(header), nonces)`` for the equivalent header
+    (tests pin them equal).
+
+    Built on :func:`compress` (scanned on CPU, unrolled on TPU) rather
+    than the symbolic partial-evaluator: with a dynamic midstate there
+    are no constants to fold, and the unrolled form would hit the
+    LLVM-chokes-on-huge-blocks compile cliff on the CI backend. The
+    little-endian nonce bytes at header offset 76 read as a big-endian
+    schedule word are simply ``byteswap(nonce)``.
+    """
+    n = nonces.shape[0]
+    tail = jnp.concatenate(
+        [
+            jnp.broadcast_to(tailw3, (n, 3)),
+            _byteswap32(nonces)[:, None],
+            jnp.broadcast_to(
+                jnp.asarray(np.array(HEADER_TAIL_PAD, dtype=np.uint32)),
+                (n, 12),
+            ),
+        ],
+        axis=-1,
+    )
+    state = compress(jnp.broadcast_to(midstate8, (n, 8)), tail)
+    block2 = jnp.concatenate(
+        [
+            state,
+            jnp.broadcast_to(
+                jnp.asarray(
+                    np.array([0x80000000, 0, 0, 0, 0, 0, 0, 256], dtype=np.uint32)
+                ),
+                (n, 8),
+            ),
+        ],
+        axis=-1,
+    )
+    return compress(jnp.broadcast_to(jnp.asarray(_H0), (n, 8)), block2)
 
 
 # ---------------------------------------------------------------------------

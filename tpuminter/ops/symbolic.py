@@ -36,6 +36,7 @@ __all__ = [
     "schedule_word",
     "inject_nonce_bytes",
     "compress_sym_e60_e61",
+    "hash_sym_e60_e61",
     "double_sha256_e60_e61",
     "CAND_E60",
     "DIGEST6_BIAS",
@@ -227,38 +228,60 @@ CAND_E60: int = (-SHA256_H0[7]) & _M32
 DIGEST6_BIAS: int = SHA256_H0[6]
 
 
-def double_sha256_e60_e61(
-    template, nonce_hi: Val, nonce_lo: Val
+def hash_sym_e60_e61(
+    midstate: Sequence[Val],
+    tail_blocks: Sequence[Sequence[Val]],
+    positions: Sequence[tuple],
+    nonce_hi: Val,
+    nonce_lo: Val,
 ) -> Tuple[Val, Val]:
-    """``(e60, e61)`` of the second compression for a double-SHA
-    template: the minimal computation deciding the hash's top 64 bits
-    (digest word 7 == 0 via :data:`CAND_E60`; hash word 1 =
+    """``(e60, e61)`` of the *second* compression of a double-SHA over a
+    symbolic message: the minimal computation deciding the hash's top 64
+    bits (digest word 7 == 0 via :data:`CAND_E60`; hash word 1 =
     byteswap(:data:`DIGEST6_BIAS` + e61)). First hash runs in full (its
-    digest feeds the second block); the second stops at round 61."""
-    if not template.double:
-        raise ValueError("e60 early-reject only applies to double-SHA templates")
-    state: List[Val] = [int(x) for x in template.midstate]
-    for b, block in enumerate(template.tail):
-        w = inject_nonce_bytes(
-            [int(x) for x in block], template.positions, b, nonce_hi, nonce_lo
-        )
+    digest feeds the second block); the second stops at round 61.
+    ``midstate``/``tail_blocks`` entries may be ints (baked templates) or
+    traced u32 scalars (the on-device extranonce roll feeds the rolled
+    midstate and merkle tail word here, BASELINE.json:9-10)."""
+    state: List[Val] = list(midstate)
+    for b, block in enumerate(tail_blocks):
+        w = inject_nonce_bytes(list(block), positions, b, nonce_hi, nonce_lo)
         state = compress_sym(state, w)
     w2: List[Val] = list(state) + [0x80000000, 0, 0, 0, 0, 0, 0, 256]
     return compress_sym_e60_e61([int(x) for x in SHA256_H0], w2)
 
 
+def double_sha256_e60_e61(
+    template, nonce_hi: Val, nonce_lo: Val
+) -> Tuple[Val, Val]:
+    """Template wrapper over :func:`hash_sym_e60_e61` with everything
+    constant (maximum folding — the baked kernels)."""
+    if not template.double:
+        raise ValueError("e60 early-reject only applies to double-SHA templates")
+    return hash_sym_e60_e61(
+        [int(x) for x in template.midstate],
+        [[int(x) for x in blk] for blk in template.tail],
+        template.positions,
+        nonce_hi,
+        nonce_lo,
+    )
+
+
 def inject_nonce_bytes(
-    tail_block: Sequence[int],
+    tail_block: Sequence[Val],
     positions: Sequence[tuple],
     block_index: int,
     nonce_hi: Val,
     nonce_lo: Val,
 ) -> List[Val]:
-    """Build one tail block's schedule words: template constants with the
+    """Build one tail block's schedule words: template words with the
     nonce bytes OR'd in at their static positions (the nonce-shaped hole
-    of a ``NonceTemplate``). Words without nonce bytes stay Python ints.
+    of a ``NonceTemplate``). Words may be Python ints (baked templates)
+    or traced u32 scalars (the dynamic-header path, where the midstate
+    and merkle tail word are produced on device by the extranonce roll);
+    constant words stay Python ints through the injection.
     """
-    w: List[Val] = list(int(x) for x in tail_block)
+    w: List[Val] = list(tail_block)
     for blk, word, word_shift, nonce_shift in positions:
         if blk != block_index:
             continue

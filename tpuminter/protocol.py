@@ -28,7 +28,7 @@ from __future__ import annotations
 import json
 from dataclasses import dataclass
 from enum import Enum
-from typing import Optional, Union
+from typing import Optional, Tuple, Union
 
 __all__ = [
     "PowMode",
@@ -87,6 +87,17 @@ class Request:
     accepts can overflow its hot loop. ``chunk_id`` identifies this
     specific dispatch; workers echo it in their Result so the scheduler
     can tell a live chunk's answer from a stale one (see coordinator).
+
+    **Rolled (extranonce) jobs** (BASELINE.json:9-10): when
+    ``coinbase_prefix is not None`` a TARGET job's search space is the
+    (extranonce × nonce) product. ``[lower, upper]`` then ranges over
+    *global indices* ``extranonce << nonce_bits | nonce``
+    (``chain.split_global``); the header's merkle-root field is ignored
+    and recomputed per extranonce from the coinbase split around its
+    ``extranonce_size`` little-endian extranonce bytes, folded up
+    ``branch``. ``nonce_bits`` is 32 in production; tests shrink it so a
+    roll happens within a tractable sweep. Workers perform the roll on
+    device (``ops.merkle.make_extranonce_roll``).
     """
 
     job_id: int
@@ -97,9 +108,32 @@ class Request:
     header: Optional[bytes] = None
     target: Optional[int] = None
     chunk_id: int = 0
+    coinbase_prefix: Optional[bytes] = None
+    coinbase_suffix: bytes = b""
+    extranonce_size: int = 4
+    branch: Tuple[bytes, ...] = ()
+    nonce_bits: int = 32
+
+    @property
+    def rolled(self) -> bool:
+        """True when this is an extranonce-rolling job."""
+        return self.coinbase_prefix is not None
 
     def __post_init__(self) -> None:
-        limit = 0xFFFFFFFF if self.mode == PowMode.TARGET else 0xFFFFFFFFFFFFFFFF
+        if self.rolled:
+            if self.mode != PowMode.TARGET:
+                raise ProtocolError("extranonce rolling requires TARGET mode")
+            if not 1 <= self.extranonce_size <= 8:
+                raise ProtocolError("extranonce_size must be in [1, 8]")
+            if not 1 <= self.nonce_bits <= 32:
+                raise ProtocolError("nonce_bits must be in [1, 32]")
+            for sib in self.branch:
+                if len(sib) != 32:
+                    raise ProtocolError("merkle branch entries must be 32 bytes")
+            span_bits = min(64, self.nonce_bits + 8 * self.extranonce_size)
+            limit = (1 << span_bits) - 1
+        else:
+            limit = 0xFFFFFFFF if self.mode == PowMode.TARGET else 0xFFFFFFFFFFFFFFFF
         if self.lower < 0 or self.upper < self.lower or self.upper > limit:
             raise ProtocolError(f"bad nonce range [{self.lower}, {self.upper}]")
         if self.mode == PowMode.TARGET:
@@ -172,6 +206,12 @@ def encode_msg(msg: Message) -> bytes:
             obj["header"] = msg.header.hex()
         if msg.target is not None:
             obj["target"] = f"{msg.target:x}"
+        if msg.rolled:
+            obj["cb_prefix"] = msg.coinbase_prefix.hex()
+            obj["cb_suffix"] = msg.coinbase_suffix.hex()
+            obj["en_size"] = msg.extranonce_size
+            obj["branch"] = [sib.hex() for sib in msg.branch]
+            obj["nonce_bits"] = msg.nonce_bits
     elif isinstance(msg, Result):
         obj = {
             "kind": "result",
@@ -212,6 +252,13 @@ def decode_msg(raw: bytes) -> Message:
                 header=bytes.fromhex(obj["header"]) if "header" in obj else None,
                 target=int(obj["target"], 16) if "target" in obj else None,
                 chunk_id=int(obj.get("chunk_id", 0)),
+                coinbase_prefix=(
+                    bytes.fromhex(obj["cb_prefix"]) if "cb_prefix" in obj else None
+                ),
+                coinbase_suffix=bytes.fromhex(obj.get("cb_suffix", "")),
+                extranonce_size=int(obj.get("en_size", 4)),
+                branch=tuple(bytes.fromhex(s) for s in obj.get("branch", [])),
+                nonce_bits=int(obj.get("nonce_bits", 32)),
             )
         if kind == "result":
             return Result(

@@ -44,6 +44,7 @@ from tpuminter import chain
 from tpuminter.kernels import (
     pallas_min_toy,
     pallas_search_candidates,
+    pallas_search_candidates_hdr,
     pallas_search_target,
 )
 from tpuminter.ops import sha256 as ops
@@ -122,6 +123,11 @@ class TpuMiner(Miner):
     def mine(self, request: Request) -> Iterator[Optional[Result]]:
         if request.mode == PowMode.MIN:
             yield from self._mine_min(request)
+        elif request.rolled:
+            if _fast_path_ok(request.target):
+                yield from self._mine_rolled_fast(request)
+            else:
+                yield from self._mine_rolled_tracking(request)
         elif self.exact_min or not _fast_path_ok(request.target):
             yield from self._mine_target_tracking(request)
         else:
@@ -157,6 +163,129 @@ class TpuMiner(Miner):
         yield Result(
             req.job_id, req.mode, nonce, hash_value, found=False,
             searched=out.searched, chunk_id=req.chunk_id,
+        )
+
+    # -- TARGET + extranonce rolling (BASELINE.json:9-10) -----------------
+
+    def _rolled_segments(self, req: Request):
+        """Global-index range → per-extranonce segments
+        ``(en, global_base, n_lo, n_hi)``."""
+        mask = (1 << req.nonce_bits) - 1
+        idx = req.lower
+        while idx <= req.upper:
+            en = idx >> req.nonce_bits
+            seg_end = min(req.upper, ((en + 1) << req.nonce_bits) - 1)
+            yield en, en << req.nonce_bits, idx & mask, seg_end & mask
+            idx = seg_end + 1
+
+    def _mine_rolled_fast(self, req: Request) -> Iterator[Optional[Result]]:
+        """The production >2^32 search: per extranonce segment the roll
+        (coinbase txid → branch fold → merkle root → header midstate)
+        runs ON DEVICE and its outputs feed the dynamic-header candidate
+        kernel directly — no header bytes cross the host boundary while
+        the nonce space is swept (BASELINE.json:9-10). The host only
+        orchestrates dispatch and verifies the ~1-per-2^32 candidates."""
+        assert req.header is not None and req.target is not None
+        from tpuminter.ops import merkle
+
+        roll = merkle.make_extranonce_roll(
+            req.header, req.coinbase_prefix, req.coinbase_suffix,
+            req.extranonce_size, req.branch,
+        )
+        cb = chain.CoinbaseTemplate(
+            req.coinbase_prefix, req.coinbase_suffix, req.extranonce_size
+        )
+        hw1_cap = jnp.uint32(int(ops.target_to_words(req.target)[1]))
+        searched = 0
+        candidates = []  # (global index, hash)
+        for en, base_g, n_lo, n_hi in self._rolled_segments(req):
+            mid, tailw = roll(jnp.uint32(en >> 32), jnp.uint32(en & 0xFFFFFFFF))
+
+            prefix76: list = []  # built lazily — only a candidate needs it
+
+            def verify(nonce: int, _en=en, _cache=prefix76) -> Tuple[bool, int]:
+                if not _cache:
+                    _cache.append(
+                        chain.rolled_header(req.header, cb, req.branch, _en)
+                        .pack()[:76]
+                    )
+                h = chain.hash_to_int(
+                    chain.dsha256(_cache[0] + struct.pack("<I", nonce))
+                )
+                return h <= req.target, h
+
+            def sweep(base: int, n: int, _mid=mid, _tailw=tailw):
+                return pallas_search_candidates_hdr(
+                    _mid, _tailw, jnp.uint32(base), n, 8, hw1_cap
+                )
+
+            def resolve(handle):
+                found, off = handle
+                return int(found), int(off)
+
+            search = CandidateSearch(
+                sweep, resolve, verify, n_lo, n_hi,
+                slab=self.slab, depth=self.depth,
+            )
+            for _ in search.events():
+                yield None
+            out = search.outcome
+            searched += out.searched
+            candidates += [(base_g | n, h) for n, h in out.candidates]
+            if out.found:
+                yield Result(
+                    req.job_id, req.mode, base_g | out.nonce, out.hash_value,
+                    found=True, searched=searched, chunk_id=req.chunk_id,
+                )
+                return
+        best = min(((h, g) for g, h in candidates), default=None)
+        hash_value, nonce = best if best else (MIN_UNTRACKED, req.lower)
+        yield Result(
+            req.job_id, req.mode, nonce, hash_value, found=False,
+            searched=searched, chunk_id=req.chunk_id,
+        )
+
+    def _mine_rolled_tracking(self, req: Request) -> Iterator[Optional[Result]]:
+        """Rolled search at toy-easy targets (≥ 2^224, where the
+        candidate test is not a necessary condition): segment loop over
+        the exact tracking kernel with host-rolled headers. Correctness
+        path only — real difficulties take :meth:`_mine_rolled_fast`."""
+        assert req.target is not None
+        cb = chain.CoinbaseTemplate(
+            req.coinbase_prefix, req.coinbase_suffix, req.extranonce_size
+        )
+        best: Optional[Tuple[int, int]] = None  # (hash, global index)
+        searched = 0
+        for en, base_g, n_lo, n_hi in self._rolled_segments(req):
+            hdr = chain.rolled_header(req.header, cb, req.branch, en)
+            sub = Request(
+                job_id=req.job_id, mode=PowMode.TARGET, lower=n_lo,
+                upper=n_hi, header=hdr.pack(), target=req.target,
+                chunk_id=req.chunk_id,
+            )
+            seg_result: Optional[Result] = None
+            for item in self._mine_target_tracking(sub):
+                if item is None:
+                    yield None
+                else:
+                    seg_result = item
+            assert seg_result is not None
+            g = base_g | seg_result.nonce
+            if seg_result.found:
+                yield Result(
+                    req.job_id, req.mode, g, seg_result.hash_value,
+                    found=True, searched=searched + seg_result.searched,
+                    chunk_id=req.chunk_id,
+                )
+                return
+            searched += seg_result.searched
+            cand = (seg_result.hash_value, g)
+            if best is None or cand < best:
+                best = cand
+        yield Result(
+            req.job_id, req.mode, best[1], best[0],
+            found=best[0] <= req.target,
+            searched=searched, chunk_id=req.chunk_id,
         )
 
     # -- TARGET: exact-min tracking kernel (compat path) ------------------

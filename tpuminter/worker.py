@@ -79,6 +79,8 @@ class CpuMiner(Miner):
     def mine(self, request: Request) -> Iterator[Optional[Result]]:
         if request.mode == PowMode.MIN:
             yield from self._mine_min(request)
+        elif request.rolled:
+            yield from self._mine_rolled(request)
         else:
             yield from self._mine_target(request)
 
@@ -118,6 +120,51 @@ class CpuMiner(Miner):
                         return
             nonce = stop
             if nonce <= req.upper:
+                yield None
+        yield Result(
+            req.job_id, req.mode, best_nonce, best_hash,
+            found=best_hash <= req.target,
+            searched=req.upper - req.lower + 1, chunk_id=req.chunk_id,
+        )
+
+    def _mine_rolled(self, req: Request) -> Iterator[Optional[Result]]:
+        """Extranonce-rolling TARGET search over global indices
+        (``chain.split_global``): host reference semantics — the header
+        is re-rolled whenever the index crosses an extranonce boundary.
+        The ground truth the device backends are pinned against.
+        """
+        assert req.target is not None
+        cb = chain.CoinbaseTemplate(
+            req.coinbase_prefix, req.coinbase_suffix, req.extranonce_size
+        )
+        mask = (1 << req.nonce_bits) - 1
+        best_hash, best_nonce = None, req.lower
+        idx = req.lower
+        cur_en, prefix = None, b""
+        while idx <= req.upper:
+            en = idx >> req.nonce_bits
+            if en != cur_en:
+                cur_en = en
+                prefix = chain.rolled_header(
+                    req.header, cb, req.branch, en
+                ).pack()[:76]
+            stop = min(
+                idx + self.batch, req.upper + 1, (en + 1) << req.nonce_bits
+            )
+            for g in range(idx, stop):
+                h = chain.hash_to_int(
+                    chain.dsha256(prefix + struct.pack("<I", g & mask))
+                )
+                if best_hash is None or h < best_hash:
+                    best_hash, best_nonce = h, g
+                    if h <= req.target:
+                        yield Result(
+                            req.job_id, req.mode, g, h, found=True,
+                            searched=g - req.lower + 1, chunk_id=req.chunk_id,
+                        )
+                        return
+            idx = stop
+            if idx <= req.upper:
                 yield None
         yield Result(
             req.job_id, req.mode, best_nonce, best_hash,
