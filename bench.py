@@ -119,6 +119,30 @@ def bench_time_to_block() -> dict:
     }
 
 
+def bench_scrypt(batch: int, steps: int = 4) -> float:
+    """Scrypt hashes/sec (BASELINE.json:11) through the shipping step
+    (``jax_worker._scrypt_step``, the same function TpuMiner delegates
+    to). Memory-hard by construction: each hash streams 256 KiB of V
+    through HBM, so this is a bandwidth benchmark, not an ALU one."""
+    from tpuminter.jax_worker import _scrypt_step
+    from tpuminter.ops import scrypt as sc
+
+    hw = jnp.asarray(sc.header_to_words(chain.GENESIS_HEADER.pack()[:76]))
+    target_words = jnp.asarray(ops.target_to_words(1))
+
+    def step(i: int):
+        nonces = jnp.uint32(1 + i * batch) + jnp.arange(batch, dtype=jnp.uint32)
+        found, *_ = _scrypt_step(hw, nonces, target_words)
+        return bool(found)
+
+    step(steps)  # compile + sync (disjoint window)
+    t0 = time.perf_counter()
+    for i in range(steps):
+        if step(i):  # target=1: unbeatable; the bool() is a real device sync
+            raise RuntimeError("impossible scrypt hit against target=1")
+    return batch * steps / (time.perf_counter() - t0)
+
+
 def bench_jnp(batch: int, secs: float = 1.0) -> float:
     template = ops.header_template(chain.GENESIS_HEADER.pack())
     target_words = jnp.asarray(ops.target_to_words(1))
@@ -145,11 +169,14 @@ def main() -> None:
     if smoke:
         jax.config.update("jax_platforms", "cpu")
         rate = bench_jnp(1 << 14)
+        extra["scrypt_khs_per_chip"] = round(bench_scrypt(64, 2) / 1e3, 3)
     elif jax.default_backend() == "cpu":
         rate = bench_jnp(1 << 14)
+        extra["scrypt_khs_per_chip"] = round(bench_scrypt(64, 2) / 1e3, 3)
     else:
         rate = bench_pipeline()
         extra = bench_time_to_block()
+        extra["scrypt_khs_per_chip"] = round(bench_scrypt(2048) / 1e3, 3)
     ghs = rate / 1e9
     print(
         json.dumps(

@@ -1,0 +1,294 @@
+"""Scrypt PoW tests (BASELINE.json:11, eval config 5; SURVEY.md §7
+stage 7): the device primitives (salsa20/8, BlockMix, ROMix) are pinned
+against an independent pure-Python RFC 7914 reference, the batched
+header hash against ``hashlib.scrypt`` (OpenSSL) bit-for-bit, the
+miners against brute force, and a scrypt job runs end-to-end through
+the cluster including the coordinator's mode-aware host verification.
+
+N is 1024 (the Litecoin parameter) everywhere a miner runs; the
+primitive tests also cover N=16 to exercise a second scan length.
+"""
+
+import hashlib
+import struct
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tpuminter import chain
+from tpuminter.ops import scrypt as sc
+from tpuminter.protocol import PowMode, ProtocolError, Request, decode_msg, encode_msg
+from tpuminter.worker import CpuMiner
+
+# ---------------------------------------------------------------------------
+# pure-Python RFC 7914 reference (r=1), validated against hashlib below
+# ---------------------------------------------------------------------------
+
+
+def _salsa_ref(inw):
+    x = [int(v) for v in inw]
+
+    def rot(a, b):
+        a &= 0xFFFFFFFF
+        return ((a << b) & 0xFFFFFFFF) | (a >> (32 - b))
+
+    for _ in range(4):
+        for tgt, a, b, r in sc._SALSA_STEPS:
+            x[tgt] ^= rot(x[a] + x[b], r)
+    return [(int(a) + b) & 0xFFFFFFFF for a, b in zip(inw, x)]
+
+
+def _blockmix_ref(x32):
+    b0, b1 = list(x32[:16]), list(x32[16:])
+    y0 = _salsa_ref([int(a) ^ int(b) for a, b in zip(b1, b0)])
+    y1 = _salsa_ref([a ^ int(b) for a, b in zip(y0, b1)])
+    return y0 + y1
+
+
+def _romix_ref(x32, n):
+    v, x = [], [int(a) for a in x32]
+    for _ in range(n):
+        v.append(x)
+        x = _blockmix_ref(x)
+    for _ in range(n):
+        x = _blockmix_ref([a ^ b for a, b in zip(x, v[x[16] % n])])
+    return x
+
+
+def test_python_reference_matches_openssl():
+    """The pure-Python pipeline (PBKDF2 → ROMix → PBKDF2) reproduces
+    hashlib.scrypt — so pinning the device primitives to it below is
+    pinning them to OpenSSL."""
+    msg = b"reference check" * 5
+    for n in (2, 16, 1024):
+        b = hashlib.pbkdf2_hmac("sha256", msg, msg, 1, 128)
+        x = np.frombuffer(b, "<u4").astype(np.uint32)
+        bp = np.array(_romix_ref(x, n), np.uint32).astype("<u4").tobytes()
+        got = hashlib.pbkdf2_hmac("sha256", msg, bp, 1, 32)
+        assert got == hashlib.scrypt(msg, salt=msg, n=n, r=1, p=1, dklen=32)
+
+
+def test_salsa_and_blockmix_and_romix():
+    rng = np.random.RandomState(7)
+    x16 = rng.randint(0, 1 << 32, 16, dtype=np.uint32)
+    assert [int(v) for v in np.asarray(sc.salsa20_8(jnp.asarray(x16)))] == _salsa_ref(x16)
+    x32 = rng.randint(0, 1 << 32, 32, dtype=np.uint32)
+    assert [int(v) for v in np.asarray(sc.block_mix(jnp.asarray(x32)))] == _blockmix_ref(x32)
+    batch = rng.randint(0, 1 << 32, (3, 32), dtype=np.uint32)
+    got = np.asarray(sc.romix(jnp.asarray(batch), 4))
+    for i in range(3):
+        assert [int(v) for v in got[i]] == _romix_ref(batch[i], 16)
+
+
+@pytest.mark.parametrize("n_log2", [4, 10])
+def test_scrypt_header_batch_matches_hashlib(n_log2):
+    hdr = chain.GENESIS_HEADER.pack()
+    hw = jnp.asarray(sc.header_to_words(hdr[:76]))
+    nonces = np.array([0, 1, 12345, 0xFFFFFFFF], np.uint32)
+    out = np.asarray(sc.scrypt_header_batch(hw, jnp.asarray(nonces), n_log2))
+    for i, n in enumerate(nonces):
+        msg = hdr[:76] + struct.pack("<I", int(n))
+        want = hashlib.scrypt(msg, salt=msg, n=1 << n_log2, r=1, p=1, dklen=32)
+        assert out[i].astype(">u4").tobytes() == want
+
+
+def test_chain_scrypt_hash():
+    hdr = chain.GENESIS_HEADER.pack()
+    assert chain.scrypt_hash(hdr) == hashlib.scrypt(
+        hdr, salt=hdr, n=1024, r=1, p=1, dklen=32
+    )
+
+
+# ---------------------------------------------------------------------------
+# protocol plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_scrypt_request_roundtrip():
+    hdr = chain.GENESIS_HEADER.pack()
+    req = Request(
+        job_id=3, mode=PowMode.SCRYPT, lower=0, upper=100,
+        header=hdr, target=1 << 240,
+    )
+    assert req.mode.targeted
+    assert decode_msg(encode_msg(req)) == req
+
+
+def test_scrypt_request_validation():
+    with pytest.raises(ProtocolError):  # needs header+target like TARGET
+        Request(job_id=1, mode=PowMode.SCRYPT, lower=0, upper=10)
+    with pytest.raises(ProtocolError):  # u32 nonce space
+        Request(job_id=1, mode=PowMode.SCRYPT, lower=0, upper=1 << 32,
+                header=chain.GENESIS_HEADER.pack(), target=1)
+
+
+# ---------------------------------------------------------------------------
+# miners vs brute force (N=1024, small ranges)
+# ---------------------------------------------------------------------------
+
+HI = 199  # range [0, HI]
+
+
+@pytest.fixture(scope="module")
+def ground_truth():
+    hdr = chain.GENESIS_HEADER.pack()
+    prefix = hdr[:76]
+    all_h = [
+        (chain.hash_to_int(chain.scrypt_hash(prefix + struct.pack("<I", n))), n)
+        for n in range(HI + 1)
+    ]
+    h_min, n_min = min(all_h)
+    return hdr, all_h, h_min, n_min
+
+
+def _drain(gen):
+    result = None
+    for item in gen:
+        if item is not None:
+            result = item
+    return result
+
+
+def test_cpu_miner_scrypt_finds_winner(ground_truth):
+    hdr, all_h, h_min, n_min = ground_truth
+    req = Request(job_id=1, mode=PowMode.SCRYPT, lower=0, upper=HI,
+                  header=hdr, target=h_min)
+    result = _drain(CpuMiner(batch=64).mine(req))
+    assert result.found
+    assert (result.nonce, result.hash_value) == (n_min, h_min)
+    assert result.searched == n_min + 1  # first-winner early exit
+
+
+def test_cpu_miner_scrypt_exhausted(ground_truth):
+    hdr, all_h, h_min, n_min = ground_truth
+    req = Request(job_id=1, mode=PowMode.SCRYPT, lower=0, upper=HI,
+                  header=hdr, target=1)
+    result = _drain(CpuMiner(batch=64).mine(req))
+    assert not result.found
+    assert (result.hash_value, result.nonce) == (h_min, n_min)
+    assert result.searched == HI + 1
+
+
+def test_jax_miner_scrypt_matches_cpu(ground_truth):
+    from tpuminter.jax_worker import JaxMiner
+
+    hdr, all_h, h_min, n_min = ground_truth
+    miner = JaxMiner(scrypt_batch=64)
+    req = Request(job_id=1, mode=PowMode.SCRYPT, lower=0, upper=HI,
+                  header=hdr, target=h_min)
+    result = _drain(miner.mine(req))
+    assert result.found
+    assert (result.nonce, result.hash_value) == (n_min, h_min)
+
+    # partial chunk with ragged final batch, unbeatable target
+    lo, hi = 37, 141
+    want = min((h, n) for h, n in all_h if lo <= n <= hi)
+    req = Request(job_id=1, mode=PowMode.SCRYPT, lower=lo, upper=hi,
+                  header=hdr, target=1)
+    result = _drain(miner.mine(req))
+    assert not result.found
+    assert (result.hash_value, result.nonce) == want
+
+
+# ---------------------------------------------------------------------------
+# rolled (extranonce) scrypt
+# ---------------------------------------------------------------------------
+
+NB = 5   # nonce_bits: 32-nonce segments
+ENS = 4  # extranonce segments
+
+
+@pytest.fixture(scope="module")
+def rolled_truth():
+    rng = np.random.RandomState(3)
+    prefix, suffix = rng.bytes(41), rng.bytes(60)
+    branch = [rng.bytes(32) for _ in range(2)]
+    hdr = chain.GENESIS_HEADER.pack()
+    cb = chain.CoinbaseTemplate(prefix, suffix, 4)
+    all_h = []
+    for en in range(ENS):
+        p76 = chain.rolled_header(hdr, cb, branch, en).pack()[:76]
+        for n in range(1 << NB):
+            h = chain.hash_to_int(chain.scrypt_hash(p76 + struct.pack("<I", n)))
+            all_h.append((h, (en << NB) | n))
+    h_min, g_min = min(all_h)
+    return prefix, suffix, branch, hdr, h_min, g_min
+
+
+def _rolled_req(rt, target):
+    prefix, suffix, branch, hdr, h_min, g_min = rt
+    return Request(
+        job_id=9, mode=PowMode.SCRYPT, lower=0, upper=(ENS << NB) - 1,
+        header=hdr, target=target, coinbase_prefix=prefix,
+        coinbase_suffix=suffix, extranonce_size=4, branch=tuple(branch),
+        nonce_bits=NB,
+    )
+
+
+def test_cpu_miner_rolled_scrypt(rolled_truth):
+    *_, h_min, g_min = rolled_truth
+    result = _drain(CpuMiner(batch=32).mine(_rolled_req(rolled_truth, h_min)))
+    assert result.found
+    assert (result.nonce, result.hash_value) == (g_min, h_min)
+
+
+def test_jax_miner_rolled_scrypt(rolled_truth):
+    from tpuminter.jax_worker import JaxMiner
+
+    *_, h_min, g_min = rolled_truth
+    result = _drain(
+        JaxMiner(scrypt_batch=32).mine(_rolled_req(rolled_truth, h_min))
+    )
+    assert result.found
+    assert (result.nonce, result.hash_value) == (g_min, h_min)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the cluster (eval config 5 shape)
+# ---------------------------------------------------------------------------
+
+
+def test_scrypt_job_end_to_end(ground_truth):
+    import asyncio
+
+    from tests.test_e2e import FAST, Cluster, run
+    from tpuminter.client import submit
+
+    hdr, all_h, h_min, n_min = ground_truth
+
+    async def scenario():
+        cluster = await Cluster.create(
+            n_miners=2, chunk_size=64,
+            miner_factory=lambda: CpuMiner(batch=32),
+        )
+        try:
+            req = Request(job_id=5, mode=PowMode.SCRYPT, lower=0, upper=HI,
+                          header=hdr, target=h_min)
+            result = await submit("127.0.0.1", cluster.coord.port, req, params=FAST)
+            assert result.found
+            assert (result.nonce, result.hash_value) == (n_min, h_min)
+            # the coordinator's mode-aware host verification accepted it
+            assert cluster.coord.stats["results_rejected"] == 0
+        finally:
+            await cluster.close()
+
+    run(scenario())
+
+
+def test_coordinator_rejects_forged_scrypt_result(ground_truth):
+    """A worker claiming a scrypt win that is really only a double-SHA
+    win must be caught by the mode-aware verifier."""
+    from tpuminter.coordinator import Coordinator
+
+    hdr, all_h, h_min, n_min = ground_truth
+    from tpuminter.protocol import Result
+
+    req = Request(job_id=1, mode=PowMode.SCRYPT, lower=0, upper=HI,
+                  header=hdr, target=h_min)
+    # forged: correct double-SHA hash of nonce 0, passed off as scrypt
+    fake_h = chain.hash_to_int(chain.dsha256(hdr[:76] + struct.pack("<I", 0)))
+    forged = Result(1, PowMode.SCRYPT, 0, fake_h, found=True)
+    assert not Coordinator._verify_result(req, forged)
+    honest = Result(1, PowMode.SCRYPT, n_min, h_min, found=True)
+    assert Coordinator._verify_result(req, honest)

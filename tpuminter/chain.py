@@ -22,7 +22,7 @@ from __future__ import annotations
 import hashlib
 import struct
 from dataclasses import dataclass, replace
-from typing import Iterable, List, Sequence, Tuple
+from typing import Iterable, Iterator, List, Sequence, Tuple
 
 __all__ = [
     "sha256",
@@ -91,6 +91,15 @@ def sha256(data: bytes) -> bytes:
 def dsha256(data: bytes) -> bytes:
     """Bitcoin's double SHA-256: SHA-256(SHA-256(data))."""
     return hashlib.sha256(hashlib.sha256(data).digest()).digest()
+
+
+def scrypt_hash(data: bytes, n: int = 1024) -> bytes:
+    """Litecoin-style scrypt PoW hash: ``scrypt(P=data, S=data, N=n,
+    r=1, p=1, dkLen=32)`` (RFC 7914 via OpenSSL; BASELINE.json:11).
+    ``data`` is the 80-byte header; the 32-byte output is interpreted
+    exactly like a double-SHA digest (``hash_to_int`` little-endian
+    value vs target). Host ground truth for ``ops.scrypt``."""
+    return hashlib.scrypt(data, salt=data, n=n, r=1, p=1, dklen=32)
 
 
 def _rotr(x: int, n: int) -> int:
@@ -397,3 +406,20 @@ def split_global(index: int, nonce_bits: int = 32) -> Tuple[int, int]:
     a tractable sweep.
     """
     return index >> nonce_bits, index & ((1 << nonce_bits) - 1)
+
+
+def rolled_segments(
+    lower: int, upper: int, nonce_bits: int = 32
+) -> Iterator[Tuple[int, int, int, int]]:
+    """Split a rolled job's global-index range ``[lower, upper]`` into
+    per-extranonce segments ``(extranonce, global_base, nonce_lo,
+    nonce_hi)`` — the spans over which the header is constant. Inverse
+    bookkeeping of :func:`split_global`; every rolled miner iterates
+    this (the single source of the en/segment arithmetic)."""
+    idx = lower
+    mask = (1 << nonce_bits) - 1
+    while idx <= upper:
+        en = idx >> nonce_bits
+        seg_end = min(upper, ((en + 1) << nonce_bits) - 1)
+        yield en, en << nonce_bits, idx & mask, seg_end & mask
+        idx = seg_end + 1

@@ -61,6 +61,9 @@ def main(argv: Optional[list] = None) -> None:
                         help="TARGET mode: compact difficulty bits (default diff-1)")
     parser.add_argument("--max-nonce", dest="max_nonce_opt", type=int,
                         default=0xFFFFFFFF, help="TARGET mode: nonce sweep bound")
+    parser.add_argument("--scrypt", action="store_true",
+                        help="with --header: scrypt PoW (Litecoin N=1024,r=1,p=1) "
+                        "instead of double-SHA256")
     args = parser.parse_args(argv)
     host, _, port = args.hostport.rpartition(":")
     logging.basicConfig(level=logging.WARNING)
@@ -69,7 +72,7 @@ def main(argv: Optional[list] = None) -> None:
         header = bytes.fromhex(args.header)
         request = Request(
             job_id=1,
-            mode=PowMode.TARGET,
+            mode=PowMode.SCRYPT if args.scrypt else PowMode.TARGET,
             lower=0,
             upper=args.max_nonce_opt,
             header=header,

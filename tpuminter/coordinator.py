@@ -63,6 +63,16 @@ DEFAULT_CHUNK_SIZE = 16_384
 #: sustain forever against its own rejected chunk
 MAX_REJECTIONS = 3
 
+#: A miner's ``lanes`` hint is its relative throughput at *double-SHA*;
+#: scrypt is ~10^3-10^4× more work per nonce (memory-hard by design), so
+#: carving ``chunk_size × lanes`` scrypt nonces would produce hours-long
+#: chunks the scheduler cannot requeue or cancel promptly. The whole
+#: chunk budget is divided by the hash-cost ratio at carve time, floored
+#: at SCRYPT_MIN_CHUNK so slow workers still amortize the RPC round-trip
+#: (~0.15 s of hashlib.scrypt at the measured ~300 µs/hash).
+SCRYPT_CHUNK_DIVISOR = 8192
+SCRYPT_MIN_CHUNK = 512
+
 
 @dataclass
 class _MinerState:
@@ -269,7 +279,7 @@ class Coordinator:
             job.hashes_done += searched
             self.stats["hashes"] += searched
             job.fold(msg.hash_value, msg.nonce)
-            if msg.found and job.request.mode == PowMode.TARGET:
+            if msg.found and job.request.mode.targeted:
                 self._finish_job(job, found=True)
             elif job.exhausted:
                 found = (
@@ -314,9 +324,8 @@ class Coordinator:
             else:
                 nonce = msg.nonce
                 prefix = req.header[:76]
-            h = chain.hash_to_int(
-                chain.dsha256(prefix + struct.pack("<I", nonce))
-            )
+            powf = chain.scrypt_hash if req.mode == PowMode.SCRYPT else chain.dsha256
+            h = chain.hash_to_int(powf(prefix + struct.pack("<I", nonce)))
         except (struct.error, TypeError, OverflowError):
             return False
         if h != msg.hash_value:
@@ -395,7 +404,10 @@ class Coordinator:
                 continue
             miner = idle.popleft()
             lo, hi = job.ranges.popleft()
-            take = min(hi - lo + 1, self._chunk_size * miner.lanes)
+            budget = self._chunk_size * miner.lanes
+            if job.request.mode == PowMode.SCRYPT:
+                budget = max(SCRYPT_MIN_CHUNK, budget // SCRYPT_CHUNK_DIVISOR)
+            take = min(hi - lo + 1, budget)
             chunk_hi = lo + take - 1
             if chunk_hi < hi:
                 job.ranges.appendleft((chunk_hi + 1, hi))

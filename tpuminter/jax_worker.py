@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpuminter import chain
+from tpuminter.ops import scrypt as scrypt_ops
 from tpuminter.ops import sha256 as ops
 from tpuminter.protocol import PowMode, Request, Result
 from tpuminter.worker import Miner
@@ -57,6 +59,25 @@ def _target_step(
     return found, first, midx, digests[midx], digests[first]
 
 
+@partial(jax.jit, static_argnums=3)
+def _scrypt_step(
+    header76w: jnp.ndarray, nonces: jnp.ndarray, target_words: jnp.ndarray,
+    n_log2: int = 10,
+):
+    """Scrypt dialect (BASELINE.json:11): same contract as
+    :func:`_target_step` with RFC 7914 scrypt as the PoW hash. The
+    header words are a *runtime* input (scrypt admits no midstate
+    specialization — the nonce sits in the PBKDF2 key), so one compile
+    serves every job and every extranonce."""
+    digests = scrypt_ops.scrypt_header_batch(header76w, nonces, n_log2)
+    hw = ops.hash_words_be(digests)
+    ok = ops.lex_le(hw, target_words)
+    found = ok.any()
+    first = jnp.argmax(ok)
+    midx = ops.lex_argmin(hw)
+    return found, first, midx, digests[midx], digests[first]
+
+
 @jax.jit
 def _rolled_step(
     mid8: jnp.ndarray, tailw3: jnp.ndarray, nonces: jnp.ndarray,
@@ -79,8 +100,17 @@ class JaxMiner(Miner):
 
     backend = "jax"
 
-    def __init__(self, batch: int = 1 << 16, lanes: Optional[int] = None):
+    def __init__(
+        self,
+        batch: int = 1 << 16,
+        lanes: Optional[int] = None,
+        scrypt_batch: int = 256,
+    ):
         self.batch = batch
+        # scrypt's ROMix scratch is 128 KiB per in-flight nonce, so the
+        # memory-hard dialect gets its own (much smaller) batch size:
+        # scrypt_batch × 128 KiB of V lives on device per step
+        self.scrypt_batch = scrypt_batch
         # scheduler hint: ask the coordinator for chunks a few batches deep
         self.lanes = lanes if lanes is not None else max(1, (batch * 4) // 16_384)
 
@@ -89,6 +119,8 @@ class JaxMiner(Miner):
     def mine(self, request: Request) -> Iterator[Optional[Result]]:
         if request.mode == PowMode.MIN:
             yield from self._mine_min(request)
+        elif request.mode == PowMode.SCRYPT:
+            yield from self._mine_scrypt(request)
         elif request.rolled:
             yield from self._mine_rolled(request)
         else:
@@ -96,7 +128,7 @@ class JaxMiner(Miner):
 
     # -- internals -------------------------------------------------------
 
-    def _batches(self, lower: int, upper: int):
+    def _batches(self, lower: int, upper: int, batch: Optional[int] = None):
         """Fixed-shape nonce batches covering [lower, upper], final batch
         padded with ``upper``; yields (start, valid_count, np_u64_array).
 
@@ -104,13 +136,14 @@ class JaxMiner(Miner):
         range ending near 2^64 cannot wrap modulo 64 bits and leak
         out-of-range nonces into the batch.
         """
+        batch = self.batch if batch is None else batch
         start = lower
         while start <= upper:
-            valid = min(self.batch, upper - start + 1)
+            valid = min(batch, upper - start + 1)
             nonces = np.uint64(start) + np.arange(valid, dtype=np.uint64)
-            if valid < self.batch:
+            if valid < batch:
                 nonces = np.concatenate(
-                    [nonces, np.full(self.batch - valid, upper, dtype=np.uint64)]
+                    [nonces, np.full(batch - valid, upper, dtype=np.uint64)]
                 )
             yield start, valid, nonces
             start += valid
@@ -164,6 +197,65 @@ class JaxMiner(Miner):
             searched=req.upper - req.lower + 1, chunk_id=req.chunk_id,
         )
 
+    def _scrypt_segments(self, req: Request):
+        """Yield ``(header76_bytes, global_base, lo, hi)`` per constant-
+        header span of the request: the whole range for a plain job, one
+        span per extranonce for a rolled one. The roll itself (coinbase →
+        merkle root → header) happens on the HOST here: at scrypt's
+        MH/s-scale rates one roll per 2^nonce_bits hashes is noise, so
+        the on-device roll machinery (``ops.merkle``) is reserved for the
+        GH/s double-SHA path where it matters."""
+        if not req.rolled:
+            yield req.header[:76], 0, req.lower, req.upper
+            return
+        cb = chain.CoinbaseTemplate(
+            req.coinbase_prefix, req.coinbase_suffix, req.extranonce_size
+        )
+        for en, base_g, n_lo, n_hi in chain.rolled_segments(
+            req.lower, req.upper, req.nonce_bits
+        ):
+            hdr76 = chain.rolled_header(req.header, cb, req.branch, en).pack()[:76]
+            yield hdr76, base_g, n_lo, n_hi
+
+    def _mine_scrypt(self, req: Request) -> Iterator[Optional[Result]]:
+        """Memory-hard dialect (BASELINE.json:11): batched scrypt with
+        the header words as runtime inputs — one compile total."""
+        assert req.target is not None
+        target_words = jnp.asarray(ops.target_to_words(req.target))
+        best: Optional[Tuple[int, int]] = None  # (hash, global index)
+        searched = 0
+        for hdr76, base_g, lo, hi in self._scrypt_segments(req):
+            hw = jnp.asarray(scrypt_ops.header_to_words(hdr76))
+            for start, valid, nonces in self._batches(lo, hi, self.scrypt_batch):
+                u32 = jnp.asarray(nonces.astype(np.uint32))
+                found, first, midx, min_digest, first_digest = _scrypt_step(
+                    hw, u32, target_words
+                )
+                if bool(found):
+                    first = int(first)
+                    g = base_g | int(nonces[first])
+                    h = ops.digest_to_int(np.asarray(first_digest))
+                    yield Result(
+                        req.job_id, req.mode, g, h, found=True,
+                        searched=searched + min(first + 1, valid),
+                        chunk_id=req.chunk_id,
+                    )
+                    return
+                midx = int(midx)
+                cand = (
+                    ops.digest_to_int(np.asarray(min_digest)),
+                    base_g | int(nonces[midx]),
+                )
+                if best is None or cand < best:
+                    best = cand
+                searched += valid
+                yield None
+        yield Result(
+            req.job_id, req.mode, best[1], best[0],
+            found=best[0] <= req.target,
+            searched=searched, chunk_id=req.chunk_id,
+        )
+
     def _mine_rolled(self, req: Request) -> Iterator[Optional[Result]]:
         """Extranonce-rolling TARGET search: the roll (coinbase txid →
         branch fold → merkle root → header midstate) runs ON DEVICE once
@@ -178,47 +270,35 @@ class JaxMiner(Miner):
             req.extranonce_size, req.branch,
         )
         target_words = jnp.asarray(ops.target_to_words(req.target))
-        mask = (1 << req.nonce_bits) - 1
         best: Optional[Tuple[int, int]] = None  # (hash, global index)
-        idx = req.lower
-        cur_en = None
-        mid = tailw = None
-        while idx <= req.upper:
-            en = idx >> req.nonce_bits
-            if en != cur_en:
-                cur_en = en
-                mid, tailw = roll(
-                    jnp.uint32(en >> 32), jnp.uint32(en & 0xFFFFFFFF)
+        for en, base_g, n_lo, n_hi in chain.rolled_segments(
+            req.lower, req.upper, req.nonce_bits
+        ):
+            mid, tailw = roll(jnp.uint32(en >> 32), jnp.uint32(en & 0xFFFFFFFF))
+            for start, valid, nonces in self._batches(n_lo, n_hi):
+                u32 = jnp.asarray(nonces.astype(np.uint32))
+                found, first, midx, min_digest, first_digest = _rolled_step(
+                    mid, tailw, u32, target_words
                 )
-            seg_end = min(req.upper, ((en + 1) << req.nonce_bits) - 1)
-            valid = min(self.batch, seg_end - idx + 1)
-            nonces = np.uint32(idx & mask) + np.arange(valid, dtype=np.uint32)
-            if valid < self.batch:
-                nonces = np.concatenate(
-                    [nonces, np.full(self.batch - valid, nonces[-1], np.uint32)]
+                if bool(found):
+                    first = int(first)
+                    g = base_g | int(nonces[first])
+                    h = ops.digest_to_int(np.asarray(first_digest))
+                    yield Result(
+                        req.job_id, req.mode, g, h, found=True,
+                        searched=min(first + 1, valid)
+                        + ((base_g | start) - req.lower),
+                        chunk_id=req.chunk_id,
+                    )
+                    return
+                midx = int(midx)
+                cand = (
+                    ops.digest_to_int(np.asarray(min_digest)),
+                    base_g | int(nonces[midx]),
                 )
-            found, first, midx, min_digest, first_digest = _rolled_step(
-                mid, tailw, jnp.asarray(nonces), target_words
-            )
-            if bool(found):
-                first = int(first)
-                g = (en << req.nonce_bits) | int(nonces[first])
-                h = ops.digest_to_int(np.asarray(first_digest))
-                yield Result(
-                    req.job_id, req.mode, g, h, found=True,
-                    searched=min(first + 1, valid) + (idx - req.lower),
-                    chunk_id=req.chunk_id,
-                )
-                return
-            midx = int(midx)
-            cand = (
-                ops.digest_to_int(np.asarray(min_digest)),
-                (en << req.nonce_bits) | int(nonces[midx]),
-            )
-            if best is None or cand < best:
-                best = cand
-            idx += valid
-            yield None
+                if best is None or cand < best:
+                    best = cand
+                yield None
         yield Result(
             req.job_id, req.mode, best[1], best[0],
             found=best[0] <= req.target,

@@ -47,6 +47,7 @@ __all__ = [
     "HEADER_NONCE_POSITIONS",
     "HEADER_TAIL_PAD",
     "header_digest_dyn",
+    "byteswap32",
     "hash_words_be",
     "lex_le",
     "lex_argmin",
@@ -344,7 +345,7 @@ def header_digest_dyn(
     tail = jnp.concatenate(
         [
             jnp.broadcast_to(tailw3, (n, 3)),
-            _byteswap32(nonces)[:, None],
+            byteswap32(nonces)[:, None],
             jnp.broadcast_to(
                 jnp.asarray(np.array(HEADER_TAIL_PAD, dtype=np.uint32)),
                 (n, 12),
@@ -372,7 +373,9 @@ def header_digest_dyn(
 # 256-bit comparisons in u32 lanes
 # ---------------------------------------------------------------------------
 
-def _byteswap32(x: jnp.ndarray) -> jnp.ndarray:
+def byteswap32(x: jnp.ndarray) -> jnp.ndarray:
+    """Per-lane u32 byte swap (big-endian ↔ little-endian word reads);
+    shared by the hash-value converters here and the scrypt word seams."""
     return (
         ((x & np.uint32(0x000000FF)) << np.uint32(24))
         | ((x & np.uint32(0x0000FF00)) << np.uint32(8))
@@ -385,7 +388,7 @@ def hash_words_be(digest_words: jnp.ndarray) -> jnp.ndarray:
     """Digest words → the 256-bit *hash value* as big-endian u32 words,
     most significant first: Bitcoin interprets the digest as a
     little-endian integer, so word j = byteswap(digest_word[7-j])."""
-    return _byteswap32(digest_words[..., ::-1])
+    return byteswap32(digest_words[..., ::-1])
 
 
 def lex_le(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:

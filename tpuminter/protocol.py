@@ -12,6 +12,9 @@ speaks two proof-of-work dialects:
 - ``PowMode.TARGET`` — the real-Bitcoin capability delta demanded by
   BASELINE.json:6-12: find any nonce with
   ``double-SHA256(header ‖ nonce) <= target``.
+- ``PowMode.SCRYPT`` — the memory-hard variant (BASELINE.json:11,
+  Litecoin N=1024/r=1/p=1): same header/target shape as TARGET with
+  ``chain.scrypt_hash`` as the PoW function.
 
 Both dialects fold the same way: every chunk Result carries the *minimum*
 hash over its range and the argmin nonce, which is an associative
@@ -60,6 +63,14 @@ class ProtocolError(ValueError):
 class PowMode(str, Enum):
     MIN = "min"        # toy PoW: minimize uint64 fold (reference parity)
     TARGET = "target"  # real PoW: double-SHA256(header) <= target
+    SCRYPT = "scrypt"  # memory-hard PoW: scrypt(header) <= target (BASELINE.json:11)
+
+    @property
+    def targeted(self) -> bool:
+        """True for the header-mining dialects (header + target + u32
+        nonce; ``found`` means the target was beaten). Only the hash
+        function differs between them."""
+        return self in (PowMode.TARGET, PowMode.SCRYPT)
 
 
 @dataclass(frozen=True)
@@ -121,8 +132,8 @@ class Request:
 
     def __post_init__(self) -> None:
         if self.rolled:
-            if self.mode != PowMode.TARGET:
-                raise ProtocolError("extranonce rolling requires TARGET mode")
+            if not self.mode.targeted:
+                raise ProtocolError("extranonce rolling requires a targeted mode")
             if not 1 <= self.extranonce_size <= 8:
                 raise ProtocolError("extranonce_size must be in [1, 8]")
             if not 1 <= self.nonce_bits <= 32:
@@ -133,14 +144,14 @@ class Request:
             span_bits = min(64, self.nonce_bits + 8 * self.extranonce_size)
             limit = (1 << span_bits) - 1
         else:
-            limit = 0xFFFFFFFF if self.mode == PowMode.TARGET else 0xFFFFFFFFFFFFFFFF
+            limit = 0xFFFFFFFF if self.mode.targeted else 0xFFFFFFFFFFFFFFFF
         if self.lower < 0 or self.upper < self.lower or self.upper > limit:
             raise ProtocolError(f"bad nonce range [{self.lower}, {self.upper}]")
-        if self.mode == PowMode.TARGET:
+        if self.mode.targeted:
             if self.header is None or len(self.header) != 80:
-                raise ProtocolError("TARGET mode needs an 80-byte header")
+                raise ProtocolError("targeted modes need an 80-byte header")
             if self.target is None or self.target <= 0:
-                raise ProtocolError("TARGET mode needs a positive target")
+                raise ProtocolError("targeted modes need a positive target")
 
 
 @dataclass(frozen=True)

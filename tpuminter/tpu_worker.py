@@ -117,12 +117,15 @@ class TpuMiner(Miner):
         self.slab = slab
         self.depth = depth
         self.exact_min = exact_min
+        self._scrypt_delegate = None
         # scheduler hint: ask for chunks a few slabs deep
         self.lanes = lanes if lanes is not None else (slab * 4) // 16_384
 
     def mine(self, request: Request) -> Iterator[Optional[Result]]:
         if request.mode == PowMode.MIN:
             yield from self._mine_min(request)
+        elif request.mode == PowMode.SCRYPT:
+            yield from self._mine_scrypt(request)
         elif request.rolled:
             if _fast_path_ok(request.target):
                 yield from self._mine_rolled_fast(request)
@@ -169,14 +172,8 @@ class TpuMiner(Miner):
 
     def _rolled_segments(self, req: Request):
         """Global-index range → per-extranonce segments
-        ``(en, global_base, n_lo, n_hi)``."""
-        mask = (1 << req.nonce_bits) - 1
-        idx = req.lower
-        while idx <= req.upper:
-            en = idx >> req.nonce_bits
-            seg_end = min(req.upper, ((en + 1) << req.nonce_bits) - 1)
-            yield en, en << req.nonce_bits, idx & mask, seg_end & mask
-            idx = seg_end + 1
+        ``(en, global_base, n_lo, n_hi)`` (``chain.rolled_segments``)."""
+        return chain.rolled_segments(req.lower, req.upper, req.nonce_bits)
 
     def _mine_rolled_fast(self, req: Request) -> Iterator[Optional[Result]]:
         """The production >2^32 search: per extranonce segment the roll
@@ -328,6 +325,23 @@ class TpuMiner(Miner):
             found=best[0] <= req.target,
             searched=searched, chunk_id=req.chunk_id,
         )
+
+    # -- SCRYPT (memory-hard) dialect --------------------------------------
+
+    def _mine_scrypt(self, req: Request) -> Iterator[Optional[Result]]:
+        """Scrypt (BASELINE.json:11) on the chip via the jnp pipeline
+        (``jax_worker._scrypt_step``): scrypt is HBM-bandwidth-bound by
+        construction (ROMix streams 128 KiB of V per hash), so XLA's
+        fused u32 VPU code with the one per-lane gather IS the right
+        TPU shape — there is no Pallas candidate trick to apply because
+        the nonce sits in the PBKDF2 key and admits no midstate or
+        partial evaluation. A bigger batch than the CPU default keeps
+        the gather-bound loop fed (256 MiB of V at 2048 lanes)."""
+        from tpuminter.jax_worker import JaxMiner
+
+        if self._scrypt_delegate is None:
+            self._scrypt_delegate = JaxMiner(scrypt_batch=2048)
+        yield from self._scrypt_delegate._mine_scrypt(req)
 
     # -- MIN (toy) dialect ------------------------------------------------
 

@@ -84,6 +84,13 @@ class CpuMiner(Miner):
         else:
             yield from self._mine_target(request)
 
+    @staticmethod
+    def _pow_fn(mode: PowMode):
+        """The targeted dialects differ only in the PoW hash
+        (protocol.PowMode): double-SHA for TARGET, RFC 7914 scrypt for
+        SCRYPT (BASELINE.json:11)."""
+        return chain.scrypt_hash if mode == PowMode.SCRYPT else chain.dsha256
+
     def _mine_min(self, req: Request) -> Iterator[Optional[Result]]:
         best_hash, best_nonce = None, req.lower
         nonce = req.lower
@@ -103,13 +110,14 @@ class CpuMiner(Miner):
 
     def _mine_target(self, req: Request) -> Iterator[Optional[Result]]:
         assert req.header is not None and req.target is not None
+        powf = self._pow_fn(req.mode)
         prefix = req.header[:76]
         best_hash, best_nonce = None, req.lower
         nonce = req.lower
         while nonce <= req.upper:
             stop = min(nonce + self.batch, req.upper + 1)
             for n in range(nonce, stop):
-                h = chain.hash_to_int(chain.dsha256(prefix + struct.pack("<I", n)))
+                h = chain.hash_to_int(powf(prefix + struct.pack("<I", n)))
                 if best_hash is None or h < best_hash:
                     best_hash, best_nonce = h, n
                     if h <= req.target:  # early exit: a winner ends the chunk
@@ -134,38 +142,33 @@ class CpuMiner(Miner):
         The ground truth the device backends are pinned against.
         """
         assert req.target is not None
+        powf = self._pow_fn(req.mode)
         cb = chain.CoinbaseTemplate(
             req.coinbase_prefix, req.coinbase_suffix, req.extranonce_size
         )
-        mask = (1 << req.nonce_bits) - 1
         best_hash, best_nonce = None, req.lower
-        idx = req.lower
-        cur_en, prefix = None, b""
-        while idx <= req.upper:
-            en = idx >> req.nonce_bits
-            if en != cur_en:
-                cur_en = en
-                prefix = chain.rolled_header(
-                    req.header, cb, req.branch, en
-                ).pack()[:76]
-            stop = min(
-                idx + self.batch, req.upper + 1, (en + 1) << req.nonce_bits
-            )
-            for g in range(idx, stop):
-                h = chain.hash_to_int(
-                    chain.dsha256(prefix + struct.pack("<I", g & mask))
-                )
-                if best_hash is None or h < best_hash:
-                    best_hash, best_nonce = h, g
-                    if h <= req.target:
-                        yield Result(
-                            req.job_id, req.mode, g, h, found=True,
-                            searched=g - req.lower + 1, chunk_id=req.chunk_id,
-                        )
-                        return
-            idx = stop
-            if idx <= req.upper:
-                yield None
+        for en, base_g, n_lo, n_hi in chain.rolled_segments(
+            req.lower, req.upper, req.nonce_bits
+        ):
+            prefix = chain.rolled_header(req.header, cb, req.branch, en).pack()[:76]
+            nonce = n_lo
+            while nonce <= n_hi:
+                stop = min(nonce + self.batch, n_hi + 1)
+                for n in range(nonce, stop):
+                    h = chain.hash_to_int(powf(prefix + struct.pack("<I", n)))
+                    if best_hash is None or h < best_hash:
+                        g = base_g | n
+                        best_hash, best_nonce = h, g
+                        if h <= req.target:
+                            yield Result(
+                                req.job_id, req.mode, g, h, found=True,
+                                searched=g - req.lower + 1, chunk_id=req.chunk_id,
+                            )
+                            return
+                nonce = stop
+                # + not |: at a segment end nonce is n_hi+1, past the mask
+                if base_g + nonce <= req.upper:
+                    yield None
         yield Result(
             req.job_id, req.mode, best_nonce, best_hash,
             found=best_hash <= req.target,
