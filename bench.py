@@ -176,7 +176,7 @@ def main() -> None:
     else:
         rate = bench_pipeline()
         extra = bench_time_to_block()
-        extra["scrypt_khs_per_chip"] = round(bench_scrypt(2048) / 1e3, 3)
+        extra["scrypt_khs_per_chip"] = round(bench_scrypt(16384) / 1e3, 3)
     ghs = rate / 1e9
     print(
         json.dumps(

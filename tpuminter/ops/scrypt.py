@@ -25,10 +25,11 @@ TPU-first design notes:
   recomputed per nonce. Consequently the header travels as a *runtime*
   (19,) u32 array — nothing job-specific is baked, one compiled
   program serves every header-mining job and every extranonce.
-- **Static shapes, static N.** ``n_log2`` is a static arg; phase 1 is a
-  ``lax.scan`` emitting V, phase 2 a ``lax.fori_loop`` carrying X.
-  Batch size fixes the compile; memory is ``batch × 128·N`` bytes for
-  V (32 MiB at batch=256, N=1024).
+- **Static shapes, static N.** ``n_log2`` is a static arg; both ROMix
+  phases are ``lax.scan``s over tuples of per-word ``(B,)`` vectors
+  (see :func:`romix` for the measured layout rationale). Batch size
+  fixes the compile; memory is ``batch × 128·N`` bytes for V (32 MiB
+  at batch=256, N=1024; 2 GiB at the TPU batch of 16384).
 
 Word-order convention: SHA-256 words are big-endian reads of the byte
 stream (as in ``ops.sha256``); salsa/BlockMix words are little-endian
@@ -66,6 +67,13 @@ _OUTER_PAD = np.array([0x80000000, 0, 0, 0, 0, 0, 0, 768], dtype=np.uint32)
 
 _bswap = ops.byteswap32  # the BE↔LE word seam (shared helper)
 
+def _compress(state, block):
+    # scanned rounds, never unrolled: the PBKDF2 walls embed 21
+    # compressions in one program, and 21 × ~7k unrolled ops push XLA
+    # compile time into minutes for ~2% of scrypt's runtime
+    return ops.compress(state, block, unroll=False)
+
+
 
 def _rotl(x: jnp.ndarray, n: int) -> jnp.ndarray:
     # no rotate ISA on TPU: shift/or pair, same as the SHA ops
@@ -90,24 +98,40 @@ _SALSA_STEPS: Tuple[Tuple[int, int, int, int], ...] = (
 )
 
 
+def _salsa20_8_words(w):
+    """Salsa20/8 on 16 separate word vectors (the TPU-dense form: each
+    word is a whole ``(B,)`` array, so every op is a full-vreg VPU op
+    with no cross-lane extracts). Returns 16 new word vectors."""
+    x = list(w)
+    for _ in range(4):
+        for tgt, a, b, rot in _SALSA_STEPS:
+            x[tgt] = x[tgt] ^ _rotl(x[a] + x[b], rot)
+    return [wi + xi for wi, xi in zip(w, x)]
+
+
+def _block_mix_words(w32):
+    """scryptBlockMix r=1 on 32 word vectors: ``Y0 = salsa(B1 ^ B0)``,
+    ``Y1 = salsa(Y0 ^ B1)``, output ``Y0 ‖ Y1`` (RFC 7914 §4)."""
+    b0, b1 = w32[:16], w32[16:]
+    y0 = _salsa20_8_words([p ^ q for p, q in zip(b1, b0)])
+    y1 = _salsa20_8_words([p ^ q for p, q in zip(y0, b1)])
+    return y0 + y1
+
+
 def salsa20_8(x: jnp.ndarray) -> jnp.ndarray:
     """Salsa20/8 core: ``(..., 16) u32`` little-endian words → same shape
     (RFC 7914 §2). 4 double rounds, then the feed-forward add."""
-    w = [x[..., i] for i in range(16)]
-    for _ in range(4):
-        for tgt, a, b, rot in _SALSA_STEPS:
-            w[tgt] = w[tgt] ^ _rotl(w[a] + w[b], rot)
-    return jnp.stack([x[..., i] + w[i] for i in range(16)], axis=-1)
+    return jnp.stack(
+        _salsa20_8_words([x[..., i] for i in range(16)]), axis=-1
+    )
 
 
 def block_mix(x: jnp.ndarray) -> jnp.ndarray:
     """scryptBlockMix for r=1: ``(..., 32) u32`` LE words → same shape
-    (RFC 7914 §4). ``Y0 = salsa(B1 ^ B0)``, ``Y1 = salsa(Y0 ^ B1)``,
-    output ``Y0 ‖ Y1`` (even blocks then odd)."""
-    b0, b1 = x[..., :16], x[..., 16:]
-    y0 = salsa20_8(b1 ^ b0)
-    y1 = salsa20_8(y0 ^ b1)
-    return jnp.concatenate([y0, y1], axis=-1)
+    (RFC 7914 §4)."""
+    return jnp.stack(
+        _block_mix_words([x[..., i] for i in range(32)]), axis=-1
+    )
 
 
 @partial(jax.jit, static_argnums=1)
@@ -115,28 +139,54 @@ def romix(x: jnp.ndarray, n_log2: int) -> jnp.ndarray:
     """scryptROMix for r=1 (RFC 7914 §5), batched: ``(B, 32) u32`` LE
     words → same shape, with ``N = 2**n_log2``.
 
-    Phase 1 (``lax.scan``) fills ``V[i] = BlockMix^i(X)`` — shape
-    ``(N, B, 32)``, the 128·N bytes/lane scratch that makes scrypt
-    memory-hard. Phase 2 (``lax.fori_loop``) does the sequential
-    data-dependent walk ``X = BlockMix(X ^ V[Integerify(X) mod N])``;
-    the per-lane ``V[j]`` read is the gather that pins throughput to
-    HBM bandwidth. Integerify for r=1 = LE word 16 (the first word of
-    the last 64-byte block)."""
+    Phase 1 (``lax.scan``) fills ``V[i] = BlockMix^i(X)``; phase 2 does
+    the sequential data-dependent walk ``X = BlockMix(X ^
+    V[Integerify(X) mod N])``. Integerify for r=1 = LE word 16 (first
+    word of the last 64-byte block).
+
+    Two TPU-measured layout choices carry the performance (each is
+    ~100× over the naive form on a v5e through this image's tunnel):
+
+    - **State lives as 32 separate ``(B,)`` word vectors**, not a
+      ``(B, 32)`` array: on TPU the minor axis is the 128-lane dim, so
+      ``x[:, i]`` word extracts inside salsa are strided cross-lane
+      ops that dominate runtime; word-per-array makes every salsa op a
+      dense full-vreg VPU op. The pack/unpack to ``(B, 32)`` happens
+      once per step (V store / V load), not ~600× per BlockMix.
+    - **V is flat ``(N·B, 32)`` and phase 2 gathers whole rows** via
+      ``v[j·B + lane]``: XLA lowers this integer row-gather well
+      (measured ~23 GB/s at B≥8192), while ``take_along_axis`` on the
+      ``(N, B, 32)`` form lowers ~100× slower. Throughput remains
+      HBM-gather bound — that is scrypt's design point (sequential
+      memory hardness), and why a memory-hard PoW on any
+      matmul-oriented part is bandwidth-, not ALU-, limited.
+    """
     n = 1 << n_log2
     batch = x.shape[0]
+    if n * batch >= 1 << 31:
+        # the flat row index is computed in u32 and cast to int32; past
+        # 2^31 rows it would wrap/clamp silently into wrong V reads
+        raise ValueError(
+            f"n*batch = {n * batch} exceeds the int32 row-index domain; "
+            "shrink the batch or n_log2"
+        )
+    lane = jnp.arange(batch, dtype=jnp.uint32)
+    words = tuple(x[:, i] for i in range(32))
 
     def fill(carry, _):
-        return block_mix(carry), carry
+        return tuple(_block_mix_words(list(carry))), jnp.stack(carry, axis=-1)
 
-    x, v = jax.lax.scan(fill, x, None, length=n)  # v: (N, B, 32)
+    words, v = jax.lax.scan(fill, words, None, length=n)  # v: (N, B, 32)
+    vflat = v.reshape(n * batch, 32)
 
-    def walk(_, carry):
-        j = carry[:, 16] & np.uint32(n - 1)  # (B,) per-lane index into V
-        idx = jnp.broadcast_to(j[None, :, None], (1, batch, 32))
-        vj = jnp.take_along_axis(v, idx.astype(jnp.int32), axis=0)[0]
-        return block_mix(carry ^ vj)
+    def walk(carry, _):
+        j = carry[16] & np.uint32(n - 1)  # (B,) per-lane index into V
+        vj = vflat[(j * np.uint32(batch) + lane).astype(jnp.int32)]
+        mixed = [c ^ vj[:, i] for i, c in enumerate(carry)]
+        return tuple(_block_mix_words(mixed)), None
 
-    return jax.lax.fori_loop(0, n, walk, x)
+    words, _ = jax.lax.scan(walk, words, None, length=n)
+    return jnp.stack(words, axis=-1)
 
 
 # ---------------------------------------------------------------------------
@@ -158,13 +208,13 @@ def _hmac_states(key8: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
         [key8 ^ np.uint32(0x5C5C5C5C),
          jnp.full(shape, 0x5C5C5C5C, jnp.uint32)], axis=-1
     )
-    return ops.compress(h0, ipad), ops.compress(h0, opad)
+    return _compress(h0, ipad), _compress(h0, opad)
 
 
 def _hmac_finish(ostate: jnp.ndarray, inner_digest: jnp.ndarray) -> jnp.ndarray:
     """Outer hash: opad state + 32-byte inner digest → (..., 8) u32."""
     pad = jnp.broadcast_to(jnp.asarray(_OUTER_PAD), inner_digest.shape)
-    return ops.compress(ostate, jnp.concatenate([inner_digest, pad], axis=-1))
+    return _compress(ostate, jnp.concatenate([inner_digest, pad], axis=-1))
 
 
 def _const_row(shape, words) -> jnp.ndarray:
@@ -204,12 +254,12 @@ def scrypt_header_batch(
         [tail3, nw, _const_row((b, 16), [0x80000000] + [0] * 10 + [640])],
         axis=-1,
     )
-    key8 = ops.compress(ops.compress(h0, block0), key_tail)
+    key8 = _compress(_compress(h0, block0), key_tail)
     istate, ostate = _hmac_states(key8)
 
     # B = PBKDF2(P=hdr, S=hdr, c=1, dkLen=128): 4 HMAC blocks, inner
     # message = S ‖ INT_BE(i). The S-block0 compression is i-independent.
-    mid = ops.compress(istate, block0)
+    mid = _compress(istate, block0)
     t_be = []
     for i in (1, 2, 3, 4):
         inner_tail = jnp.concatenate(
@@ -217,7 +267,7 @@ def scrypt_header_batch(
              _const_row((b, 16), [i, 0x80000000] + [0] * 9 + [1184])],
             axis=-1,
         )
-        t_be.append(_hmac_finish(ostate, ops.compress(mid, inner_tail)))
+        t_be.append(_hmac_finish(ostate, _compress(mid, inner_tail)))
     x = _bswap(jnp.concatenate(t_be, axis=-1))  # (B, 32) LE words
 
     x = romix_impl(x, n_log2)
@@ -225,9 +275,9 @@ def scrypt_header_batch(
     # out = PBKDF2(P=hdr, S=B', c=1, dkLen=32): one HMAC block, inner
     # message = B'(128 bytes) ‖ INT_BE(1)
     bp = _bswap(x)  # B' bytes as BE schedule words
-    st = ops.compress(ops.compress(istate, bp[:, :16]), bp[:, 16:])
+    st = _compress(_compress(istate, bp[:, :16]), bp[:, 16:])
     last = _const_row((b, 16), [1, 0x80000000] + [0] * 13 + [1568])
-    return _hmac_finish(ostate, ops.compress(st, last))
+    return _hmac_finish(ostate, _compress(st, last))
 
 
 def header_to_words(header_prefix76: bytes) -> np.ndarray:

@@ -78,7 +78,9 @@ def _round_unroll() -> bool:
     return jax.default_backend() not in ("cpu",)
 
 
-def compress(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
+def compress(
+    state: jnp.ndarray, block: jnp.ndarray, unroll: bool | None = None
+) -> jnp.ndarray:
     """One SHA-256 compression: ``state (..., 8) u32``, ``block (..., 16)
     u32`` → ``(..., 8) u32``, elementwise over leading batch dims.
 
@@ -87,8 +89,14 @@ def compress(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
     16-word window (w[i+16] = w[i] + σ0(w[i+1]) + w[i+9] + σ1(w[i+14])),
     which keeps the scanned form O(1) state; the unrolled form emits the
     same dataflow flattened.
+
+    ``unroll`` overrides the backend default (:func:`_round_unroll`).
+    Callers that embed MANY compressions in one program (the scrypt
+    PBKDF2 walls: 21 of them) pass ``False`` — 21 × ~7k unrolled ops
+    bloat the XLA program into minutes of compile time for a stage
+    that is ~2% of scrypt's runtime.
     """
-    if _round_unroll():
+    if _round_unroll() if unroll is None else unroll:
         return _compress_unrolled(state, block)
     return _compress_scanned(state, block)
 

@@ -335,12 +335,15 @@ class TpuMiner(Miner):
         fused u32 VPU code with the one per-lane gather IS the right
         TPU shape — there is no Pallas candidate trick to apply because
         the nonce sits in the PBKDF2 key and admits no midstate or
-        partial evaluation. A bigger batch than the CPU default keeps
-        the gather-bound loop fed (256 MiB of V at 2048 lanes)."""
+        partial evaluation. The batch is sized from v5e measurements
+        (ops/scrypt.romix docstring): 16384 lanes (2 GiB of V in HBM)
+        runs ~17 kH/s with ~1 s per device step — big enough to
+        amortize the serial-loop floor, small enough that Cancels land
+        within a step."""
         from tpuminter.jax_worker import JaxMiner
 
         if self._scrypt_delegate is None:
-            self._scrypt_delegate = JaxMiner(scrypt_batch=2048)
+            self._scrypt_delegate = JaxMiner(scrypt_batch=16384)
         yield from self._scrypt_delegate._mine_scrypt(req)
 
     # -- MIN (toy) dialect ------------------------------------------------
