@@ -68,10 +68,13 @@ def test_sweep_exhausted_reports_exact_pod_minimum(mesh, genesis_sweep):
     assert (ops.digest_to_int(np.asarray(digest)), int(nonce)) == want
 
 
+NO_LIMIT = (jnp.uint32(0xFFFFFFFF), jnp.uint32(0xFFFFFFFF))
+
+
 def test_min_fold_is_exact_across_devices(mesh):
     template = ops.toy_template(b"pod fold")
     fold = build_min_fold(mesh, template, batch_per_device=128)
-    fh, fl, nh, nl = fold(jnp.uint32(0), jnp.uint32(0))
+    fh, fl, nh, nl = fold(jnp.uint32(0), jnp.uint32(0), *NO_LIMIT)
     got = ((int(fh) << 32) | int(fl), (int(nh) << 32) | int(nl))
     want = min((chain.toy_hash(b"pod fold", i), i) for i in range(8 * 128))
     assert got == want
@@ -83,12 +86,26 @@ def test_min_fold_64bit_start_carry(mesh):
     fold = build_min_fold(mesh, template, batch_per_device=128)
     start = (1 << 32) - 300  # shards straddle the 2^32 boundary
     fh, fl, nh, nl = fold(
-        jnp.uint32(start >> 32), jnp.uint32(start & 0xFFFFFFFF)
+        jnp.uint32(start >> 32), jnp.uint32(start & 0xFFFFFFFF), *NO_LIMIT
     )
     got = ((int(fh) << 32) | int(fl), (int(nh) << 32) | int(nl))
     want = min(
         (chain.toy_hash(b"carry", start + i), start + i) for i in range(8 * 128)
     )
+    assert got == want
+
+
+def test_min_fold_limit_masks_ragged_tail(mesh):
+    """Nonces past the 64-bit limit must not win the fold — the ragged
+    final step of a chunk stays exact."""
+    template = ops.toy_template(b"ragged")
+    fold = build_min_fold(mesh, template, batch_per_device=128)
+    limit = 700  # mask the last 324 of the 1024-nonce span
+    fh, fl, nh, nl = fold(
+        jnp.uint32(0), jnp.uint32(0), jnp.uint32(0), jnp.uint32(limit)
+    )
+    got = ((int(fh) << 32) | int(fl), (int(nh) << 32) | int(nl))
+    want = min((chain.toy_hash(b"ragged", i), i) for i in range(limit + 1))
     assert got == want
 
 

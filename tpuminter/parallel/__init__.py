@@ -11,9 +11,15 @@ Joining the coordinator like any other miner.
 """
 
 from tpuminter.parallel.mesh import (
+    build_candidate_sweep,
     build_min_fold,
     build_target_sweep,
     make_mesh,
 )
 
-__all__ = ["make_mesh", "build_target_sweep", "build_min_fold"]
+__all__ = [
+    "make_mesh",
+    "build_target_sweep",
+    "build_min_fold",
+    "build_candidate_sweep",
+]

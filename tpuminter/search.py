@@ -94,8 +94,10 @@ class CandidateSearch:
     ):
         if not 0 <= lower <= upper < 1 << 32:
             raise ValueError(f"bad range [{lower}, {upper}]")
-        if not 1 <= slab <= 1 << 30:
-            raise ValueError("slab must be in [1, 2^30]")
+        # 2^32 admits a whole-pod span (PodMiner); the single-chip
+        # kernels cap their own n at 2^30 (int32 offset domain)
+        if not 1 <= slab <= 1 << 32:
+            raise ValueError("slab must be in [1, 2^32]")
         if depth < 1:
             raise ValueError("depth must be >= 1")
         self._sweep, self._resolve, self._verify = sweep, resolve, verify

@@ -292,7 +292,16 @@ def _build_miner(
         if depth is not None:
             kwargs["depth"] = depth
         return TpuMiner(**kwargs)
-    raise SystemExit(f"unknown backend {backend!r} (expected cpu|jax|tpu)")
+    if backend == "pod":
+        from tpuminter.pod_worker import PodMiner
+
+        kwargs = {}
+        if slab is not None:
+            kwargs["slab_per_device"] = slab
+        if depth is not None:
+            kwargs["depth"] = depth
+        return PodMiner(**kwargs)
+    raise SystemExit(f"unknown backend {backend!r} (expected cpu|jax|tpu|pod)")
 
 
 def main(argv: Optional[list] = None) -> None:
@@ -302,7 +311,11 @@ def main(argv: Optional[list] = None) -> None:
 
     parser = argparse.ArgumentParser(description="tpuminter worker (miner role)")
     parser.add_argument("hostport", help="coordinator address, host:port")
-    parser.add_argument("--backend", default="cpu", help="cpu|jax|tpu (default cpu)")
+    parser.add_argument(
+        "--backend", default="cpu",
+        help="cpu|jax|tpu|pod (default cpu; pod drives every chip of "
+        "the local slice as one worker)",
+    )
     parser.add_argument(
         "--exact-min", action="store_true",
         help="tpu backend: track the exact exhausted-range minimum "
