@@ -43,7 +43,7 @@ from tpuminter import chain
 from tpuminter.ops import sha256 as ops
 from tpuminter.parallel import build_candidate_sweep, build_min_fold, make_mesh
 from tpuminter.protocol import MIN_UNTRACKED, PowMode, Request, Result
-from tpuminter.search import CandidateSearch
+from tpuminter.search import CandidateSearch, pack_handle, resolve_handle
 from tpuminter.worker import Miner
 
 __all__ = ["PodMiner"]
@@ -138,14 +138,11 @@ class PodMiner(Miner):
         design guarantees pod-wide (``parallel.build_candidate_sweep``)."""
 
         def sweep(base: int, n: int):
-            return sweep_fn(jnp.uint32(base))
-
-        def resolve(handle):
-            found, off, _ = handle
-            return int(found), int(off)
+            found, off, _ = sweep_fn(jnp.uint32(base))  # stripes unused
+            return pack_handle(found, off)
 
         return CandidateSearch(
-            sweep, resolve, verify, lower, upper,
+            sweep, resolve_handle, verify, lower, upper,
             slab=self.pod_span, depth=self.depth,
         )
 

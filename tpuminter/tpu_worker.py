@@ -49,7 +49,7 @@ from tpuminter.kernels import (
 )
 from tpuminter.ops import sha256 as ops
 from tpuminter.protocol import MIN_UNTRACKED, PowMode, Request, Result
-from tpuminter.search import CandidateSearch
+from tpuminter.search import CandidateSearch, pack_handle, resolve_handle
 from tpuminter.worker import Miner
 
 __all__ = ["TpuMiner", "make_header_search"]
@@ -80,13 +80,12 @@ def make_header_search(header80: bytes, target: int, tiles_per_step: int = 8):
     hw1_cap = jnp.uint32(int(ops.target_to_words(target)[1]))
 
     def sweep(base: int, n: int):
-        return pallas_search_candidates(
+        found, off = pallas_search_candidates(
             template, jnp.uint32(base), n, tiles_per_step, hw1_cap
         )
+        return pack_handle(found, off)
 
-    def resolve(handle):
-        found, off = handle
-        return int(found), int(off)
+    resolve = resolve_handle
 
     def verify(nonce: int) -> Tuple[bool, int]:
         h = chain.hash_to_int(
@@ -212,13 +211,10 @@ class TpuMiner(Miner):
                 return h <= req.target, h
 
             def sweep(base: int, n: int, _mid=mid, _tailw=tailw):
-                return pallas_search_candidates_hdr(
+                found, off = pallas_search_candidates_hdr(
                     _mid, _tailw, jnp.uint32(base), n, 8, hw1_cap
                 )
-
-            def resolve(handle):
-                found, off = handle
-                return int(found), int(off)
+                return pack_handle(found, off)
 
             search = CandidateSearch(
                 sweep, resolve, verify, n_lo, n_hi,
