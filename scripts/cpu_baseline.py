@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Measure the CPU baselines BASELINE.md calls for (SURVEY.md §6: "get a
+*measured* CPU baseline ... so speedups are grounded"; VERDICT r2 #6).
+
+Two measurements, printed as one JSON line:
+
+1. ``single_worker_mhs`` — one ``CpuMiner`` (the reference-style
+   hashlib hot loop) exhausting a fixed TARGET range in-process, driven
+   through its real generator interface.
+2. ``aggregate_8_workers_mhs`` — the reference's distributed config
+   (BASELINE.json:8): a real coordinator process and EIGHT worker
+   *processes* (separate interpreters — the GIL forbids measuring an
+   aggregate inside one process) mining one exhaustion job end-to-end
+   through the LSP control plane, timed at the client.
+
+Both use an unbeatable target (1) so the sweep never early-exits and
+``searched`` is exactly the range size. Also records the single-core
+scrypt rate (``hashlib.scrypt``) for the memory-hard dialect's
+denominator.
+
+Usage: ``python scripts/cpu_baseline.py [--range-log2 21]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import struct
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpuminter import chain  # noqa: E402
+from tpuminter.protocol import PowMode, Request  # noqa: E402
+from tpuminter.worker import CpuMiner  # noqa: E402
+
+HDR = chain.GENESIS_HEADER.pack()
+
+
+def bench_single(range_log2: int) -> float:
+    n = 1 << range_log2
+    req = Request(job_id=1, mode=PowMode.TARGET, lower=0, upper=n - 1,
+                  header=HDR, target=1)
+    t0 = time.perf_counter()
+    result = None
+    for item in CpuMiner(batch=65536).mine(req):
+        if item is not None:
+            result = item
+    dt = time.perf_counter() - t0
+    assert result is not None and result.searched == n
+    return n / dt
+
+
+def bench_scrypt_single(samples: int = 512) -> float:
+    prefix = HDR[:76]
+    t0 = time.perf_counter()
+    for i in range(samples):
+        chain.scrypt_hash(prefix + struct.pack("<I", i))
+    return samples / (time.perf_counter() - t0)
+
+
+def bench_cluster(range_log2: int, n_workers: int = 8,
+                  port: int = 47421) -> float:
+    n = 1 << range_log2
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "tpuminter.coordinator", str(port)],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+    ]
+    try:
+        time.sleep(1.0)
+        procs += [
+            subprocess.Popen(
+                [sys.executable, "-m", "tpuminter.worker", f"127.0.0.1:{port}"],
+                env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+            for _ in range(n_workers)
+        ]
+        time.sleep(2.0)  # workers join
+
+        async def run_job() -> float:
+            from tpuminter.client import submit
+
+            req = Request(job_id=1, mode=PowMode.TARGET, lower=0,
+                          upper=n - 1, header=HDR, target=1)
+            t0 = time.perf_counter()
+            result = await submit("127.0.0.1", port, req)
+            dt = time.perf_counter() - t0
+            assert result.searched == n, f"short search: {result.searched}"
+            return n / dt
+
+        return asyncio.run(run_job())
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--range-log2", type=int, default=21,
+                    help="single-worker range; the cluster job uses 8x this")
+    args = ap.parse_args()
+    single = bench_single(args.range_log2)
+    aggregate = bench_cluster(args.range_log2 + 3)
+    scrypt = bench_scrypt_single()
+    print(json.dumps({
+        "single_worker_mhs": round(single / 1e6, 4),
+        "aggregate_8_workers_mhs": round(aggregate / 1e6, 4),
+        "scrypt_single_core_khs": round(scrypt / 1e3, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -217,7 +217,7 @@ class TpuMiner(Miner):
                 return pack_handle(found, off)
 
             search = CandidateSearch(
-                sweep, resolve, verify, n_lo, n_hi,
+                sweep, resolve_handle, verify, n_lo, n_hi,
                 slab=self.slab, depth=self.depth,
             )
             for _ in search.events():
