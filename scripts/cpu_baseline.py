@@ -67,7 +67,12 @@ def bench_cluster(range_log2: int, n_workers: int = 8,
                   port: int = 47421) -> float:
     n = 1 << range_log2
     env = dict(os.environ)
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # PREPEND: clobbering PYTHONPATH would drop site hooks the image
+    # relies on (e.g. the TPU plugin registration dir)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo, env.get("PYTHONPATH")) if p
+    )
     procs = [
         subprocess.Popen(
             [sys.executable, "-m", "tpuminter.coordinator", str(port)],

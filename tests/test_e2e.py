@@ -436,3 +436,37 @@ def test_cancelled_miners_are_redispatched():
             await cluster.close()
 
     run(scenario())
+
+
+def test_worker_stats_after_job():
+    """Observability (SURVEY.md §5; VERDICT r2 #7): after a job, the
+    coordinator's per-worker snapshots account for every verified hash,
+    with rate and liveness fields populated."""
+
+    async def scenario():
+        cluster = await Cluster.create(
+            n_miners=2, chunk_size=1000,
+            miner_factory=lambda: CpuMiner(batch=256),
+        )
+        try:
+            req = Request(job_id=1, mode=PowMode.MIN, lower=0, upper=7999,
+                          data=b"stats")
+            result = await submit(
+                "127.0.0.1", cluster.coord.port, req, params=FAST
+            )
+            assert result.found
+            stats = cluster.coord.worker_stats()
+            assert len(stats) == 2
+            # MIN mode has no early exit: every nonce is searched exactly
+            # once, and both workers got chunks (8 chunks, 2 workers)
+            assert sum(s["hashes"] for s in stats.values()) == 8000
+            for snap in stats.values():
+                assert snap["backend"] == "cpu"
+                assert snap["chunks_done"] >= 1
+                assert snap["mhs"] > 0
+                assert snap["idle_s"] is not None
+                assert not snap["busy"]
+        finally:
+            await cluster.close()
+
+    run(scenario())

@@ -85,6 +85,28 @@ class _MinerState:
     #: not clobber the miner's next assignment.
     chunk: Optional[Tuple[int, int, int, int]] = None
     rejections: int = 0
+    #: per-worker observability (SURVEY.md §5): verified work only
+    hashes: int = 0
+    chunks_done: int = 0
+    joined: float = field(default_factory=time.monotonic)
+    last_result: Optional[float] = None
+
+    def snapshot(self) -> dict:
+        """Rate/liveness view for :meth:`Coordinator.worker_stats`."""
+        now = time.monotonic()
+        alive = now - self.joined
+        return {
+            "backend": self.backend,
+            "lanes": self.lanes,
+            "hashes": self.hashes,
+            "chunks_done": self.chunks_done,
+            "mhs": round(self.hashes / alive / 1e6, 4) if alive > 0 else 0.0,
+            "busy": self.chunk is not None,
+            "idle_s": (
+                None if self.last_result is None
+                else round(now - self.last_result, 3)
+            ),
+        }
 
 
 @dataclass
@@ -278,6 +300,9 @@ class Coordinator:
             searched = msg.searched if msg.searched > 0 else hi - lo + 1
             job.hashes_done += searched
             self.stats["hashes"] += searched
+            miner.hashes += searched
+            miner.chunks_done += 1
+            miner.last_result = time.monotonic()
             job.fold(msg.hash_value, msg.nonce)
             if msg.found and job.request.mode.targeted:
                 self._finish_job(job, found=True)
@@ -353,8 +378,25 @@ class Coordinator:
             "job %d done in %.3fs: found=%s nonce=%d (%.2f MH/s across workers)",
             job.job_id, elapsed, found, nonce, rate / 1e6,
         )
+        # per-worker breakdown (SURVEY.md §5 observability): who did the
+        # work and at what lifetime rate
+        for conn_id, snap in self.worker_stats().items():
+            log.info(
+                "  worker %d (%s): %d hashes in %d chunks, %.3f MH/s, %s",
+                conn_id, snap["backend"], snap["hashes"],
+                snap["chunks_done"], snap["mhs"],
+                "busy" if snap["busy"] else "idle",
+            )
         self.stats["jobs_done"] += 1
         self._retire_job(job)
+
+    def worker_stats(self) -> Dict[int, dict]:
+        """Per-worker rate/liveness snapshots (conn_id → dict): verified
+        hashes, chunks completed, lifetime MH/s, busy flag, seconds
+        since the last accepted Result. The coordinator-side view the
+        reference never had (SURVEY.md §5: observability is a rebuild
+        requirement, not a port)."""
+        return {m.conn_id: m.snapshot() for m in self._miners.values()}
 
     def _abandon_job(self, job_id: int) -> None:
         job = self._jobs.get(job_id)

@@ -42,7 +42,7 @@ from tpuminter.protocol import (
     encode_msg,
 )
 
-__all__ = ["Miner", "CpuMiner", "run_miner", "main"]
+__all__ = ["Miner", "CpuMiner", "ProfiledMiner", "run_miner", "main"]
 
 log = logging.getLogger("tpuminter.worker")
 
@@ -174,6 +174,60 @@ class CpuMiner(Miner):
             found=best_hash <= req.target,
             searched=req.upper - req.lower + 1, chunk_id=req.chunk_id,
         )
+
+
+class ProfiledMiner(Miner):
+    """Decorator Miner: records one ``jax.profiler`` trace of a WARM
+    steady-state window — the work between generator steps 1 and 3 of
+    the first sufficiently long chunk — to ``log_dir`` (SURVEY.md §5
+    observability, the device-side complement to the coordinator's
+    per-worker rates).
+
+    Why a window and not the whole chunk: tracing from the first step
+    swallows the initial XLA compile (~40 s through the remote-TPU
+    tunnel), and the profiler's stop/serialize of such a trace blocks
+    the interpreter long enough that LSP epoch heartbeats stop and the
+    coordinator declares the worker dead mid-profile (observed live).
+    A two-step warm window captures the steady-state kernel pipeline —
+    the thing worth looking at — and serializes in milliseconds. The
+    window opens at step 1: a device miner's first yield happens only
+    after its first batch RESOLVES, so the compile is already behind
+    it. Short chunks that end inside the window still close the trace
+    cleanly (the ``finally``), capturing whatever ran.
+    """
+
+    _START_STEP, _STOP_STEP = 1, 3
+
+    def __init__(self, inner: Miner, log_dir: str):
+        self._inner = inner
+        self._log_dir = log_dir
+        self._traced = False
+        self.backend = inner.backend
+        self.lanes = inner.lanes
+
+    def mine(self, request: Request) -> Iterator[Optional[Result]]:
+        if self._traced:
+            yield from self._inner.mine(request)
+            return
+        import jax
+
+        tracing = False
+        step = 0
+        try:
+            for item in self._inner.mine(request):
+                step += 1
+                if step == self._START_STEP and not self._traced:
+                    log.info("profiling steady-state window to %s", self._log_dir)
+                    jax.profiler.start_trace(self._log_dir)
+                    tracing = True
+                    self._traced = True
+                elif step == self._STOP_STEP and tracing:
+                    jax.profiler.stop_trace()
+                    tracing = False
+                yield item
+        finally:
+            if tracing:
+                jax.profiler.stop_trace()
 
 
 async def run_miner(
@@ -329,12 +383,26 @@ def main(argv: Optional[list] = None) -> None:
         "--depth", type=int, default=None,
         help="tpu backend: device calls kept in flight (default 2)",
     )
+    parser.add_argument(
+        "--profile", metavar="DIR", default=None,
+        help="record a jax.profiler trace of the first mined chunk "
+        "into DIR (viewable with tensorboard/xprof)",
+    )
     args = parser.parse_args(argv)
     host, _, port = args.hostport.rpartition(":")
     logging.basicConfig(level=logging.INFO)
     miner = _build_miner(
         args.backend, exact_min=args.exact_min, slab=args.slab, depth=args.depth
     )
+    if args.profile:
+        try:
+            import jax  # noqa: F401  (fail at startup, not mid-chunk)
+        except ImportError as exc:
+            raise SystemExit(
+                "--profile needs jax (the cpu backend itself does not); "
+                f"import failed: {exc}"
+            )
+        miner = ProfiledMiner(miner, args.profile)
     asyncio.run(run_miner(host or "127.0.0.1", int(port), miner))
 
 
