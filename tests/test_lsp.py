@@ -294,3 +294,31 @@ def test_client_read_after_graceful_close_raises():
         await server.close()
 
     run(scenario())
+
+
+def test_smoke_runners_roundtrip(capsys):
+    """The srunner/crunner smoke pair (SURVEY.md §2 #11): echo server and
+    client exercise the bare LSP stack end-to-end in-process."""
+    import asyncio
+
+    from tpuminter.lsp import crunner, srunner
+
+    async def scenario():
+        server = asyncio.create_task(srunner.serve(47391))
+        await asyncio.sleep(0.2)
+        try:
+            await asyncio.wait_for(
+                crunner.run("127.0.0.1", 47391, ["alpha", "beta"]), 10.0
+            )
+        finally:
+            server.cancel()
+            try:
+                await server
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    asyncio.run(scenario())
+    out = capsys.readouterr().out
+    assert out.splitlines() == [
+        "alpha", "beta", "done: 2 replies, in order, loss-free"
+    ]

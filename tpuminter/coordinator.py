@@ -100,7 +100,9 @@ class _MinerState:
             "lanes": self.lanes,
             "hashes": self.hashes,
             "chunks_done": self.chunks_done,
-            "mhs": round(self.hashes / alive / 1e6, 4) if alive > 0 else 0.0,
+            # raw, unrounded: a lifetime rate below 50 H/s must not
+            # floor to 0.0 (callers/tests check mhs > 0; logs format it)
+            "mhs": self.hashes / alive / 1e6 if alive > 0 else 0.0,
             "busy": self.chunk is not None,
             "idle_s": (
                 None if self.last_result is None

@@ -1,0 +1,63 @@
+"""srunner: standalone LSP echo server (≙ the reference's ``lsp/srunner``
+smoke runner, SURVEY.md §2 #11).
+
+Exercises :class:`~tpuminter.lsp.LspServer` with no application layer on
+top: every payload read is logged and echoed back to its sender;
+connection loss is logged. Pair with ``python -m tpuminter.lsp.crunner``
+(or several) for manual protocol poking — window behavior, heartbeats,
+reconnects, kill -9 recovery — exactly what the reference's staff
+runners existed for.
+
+Usage: ``python -m tpuminter.lsp.srunner [port] [--drop PCT]``
+(``--drop`` injects receive-side packet loss through the transport seam,
+``lsp.transport``, to watch retransmission happen live).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+from typing import Optional
+
+from tpuminter.lsp import LspServer
+from tpuminter.lsp.params import FAST
+
+log = logging.getLogger("tpuminter.lsp.srunner")
+
+
+async def serve(port: int, drop_pct: float = 0.0) -> None:
+    server = await LspServer.create(port, FAST)
+    if drop_pct:
+        server.endpoint.set_read_drop_rate(drop_pct / 100.0)
+    log.info("echo server on port %d (drop=%.0f%%)", server.port, drop_pct)
+    try:
+        while True:
+            conn_id, payload = await server.read()
+            if payload is None:
+                log.info("conn %d lost", conn_id)
+                continue
+            log.info("conn %d -> %r", conn_id, payload)
+            try:
+                server.write(conn_id, payload)
+            except ConnectionError:
+                log.info("conn %d died before echo", conn_id)
+    finally:
+        await server.close()
+
+
+def main(argv: Optional[list] = None) -> None:
+    parser = argparse.ArgumentParser(description="LSP echo server (smoke runner)")
+    parser.add_argument("port", nargs="?", type=int, default=9090)
+    parser.add_argument("--drop", type=float, default=0.0,
+                        help="simulated receive packet loss, percent")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    try:
+        asyncio.run(serve(args.port, args.drop))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -202,32 +202,45 @@ class ProfiledMiner(Miner):
         self._inner = inner
         self._log_dir = log_dir
         self._traced = False
+        self._tracing = False
         self.backend = inner.backend
         self.lanes = inner.lanes
 
+    def _stop_trace(self) -> None:
+        import jax
+
+        jax.profiler.stop_trace()
+        self._tracing = False
+
     def mine(self, request: Request) -> Iterator[Optional[Result]]:
+        # The role loop may ABANDON a mid-trace generator on Cancel (the
+        # Miner contract allows it), so closing the trace must not
+        # depend on this generator finishing — and a GC-time finalizer
+        # would run jax's trace serialization on the event-loop thread,
+        # the heartbeat-starving hazard the class docstring describes.
+        # Instead any still-open trace is closed HERE, at the start of
+        # the next chunk: generator bodies run on the executor thread.
+        if self._tracing:
+            log.info("closing trace abandoned by a cancelled chunk")
+            self._stop_trace()
         if self._traced:
             yield from self._inner.mine(request)
             return
         import jax
 
-        tracing = False
         step = 0
-        try:
-            for item in self._inner.mine(request):
-                step += 1
-                if step == self._START_STEP and not self._traced:
-                    log.info("profiling steady-state window to %s", self._log_dir)
-                    jax.profiler.start_trace(self._log_dir)
-                    tracing = True
-                    self._traced = True
-                elif step == self._STOP_STEP and tracing:
-                    jax.profiler.stop_trace()
-                    tracing = False
-                yield item
-        finally:
-            if tracing:
-                jax.profiler.stop_trace()
+        for item in self._inner.mine(request):
+            step += 1
+            if step == self._START_STEP and not self._traced:
+                log.info("profiling steady-state window to %s", self._log_dir)
+                jax.profiler.start_trace(self._log_dir)
+                self._tracing = True
+                self._traced = True
+            elif step == self._STOP_STEP and self._tracing:
+                self._stop_trace()
+            yield item
+        if self._tracing:  # chunk ended inside the window
+            self._stop_trace()
 
 
 async def run_miner(
