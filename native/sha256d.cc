@@ -1,0 +1,208 @@
+// Native CPU mining core (SURVEY.md §2 #9 parity): the reference's CPU
+// worker is a *compiled* Go hot loop (~MH/s-scale); the Python CpuMiner
+// reproduces its semantics but not its speed class. This translation
+// unit provides the compiled equivalent — a double-SHA-256 nonce-range
+// search with first-winner early exit and exact min tracking — exposed
+// through a minimal C ABI that tpuminter/native_worker.py binds with
+// ctypes (no pybind11 in this image; see Makefile).
+//
+// Semantics are pinned bit-for-bit to tpuminter.chain/CpuMiner by
+// tests/test_native.py: same first-winner rule, same lexicographic
+// 256-bit min fold, same searched accounting.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+};
+
+constexpr uint32_t H0[8] = {
+    0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+    0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
+};
+
+inline uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+inline void compress(uint32_t state[8], const uint32_t w_in[16]) {
+  uint32_t w[64];
+  std::memcpy(w, w_in, 16 * sizeof(uint32_t));
+  for (int i = 16; i < 64; ++i) {
+    uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = state[0], b = state[1], c = state[2], d = state[3];
+  uint32_t e = state[4], f = state[5], g = state[6], h = state[7];
+  for (int i = 0; i < 64; ++i) {
+    uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+    uint32_t ch = g ^ (e & (f ^ g));
+    uint32_t t1 = h + S1 + ch + K[i] + w[i];
+    uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+    uint32_t maj = (a & b) ^ (c & (a ^ b));
+    uint32_t t2 = S0 + maj;
+    h = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  state[0] += a; state[1] += b; state[2] += c; state[3] += d;
+  state[4] += e; state[5] += f; state[6] += g; state[7] += h;
+}
+
+inline uint32_t load_be(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+// hash VALUE words, most-significant first: Bitcoin reads the 32-byte
+// digest as a little-endian integer, so value word j is the byteswap of
+// digest word 7-j (same convention as ops.sha256.hash_words_be).
+inline uint32_t bswap(uint32_t x) { return __builtin_bswap32(x); }
+
+// lexicographic compare of two 8-word msb-first values: a < b
+inline bool lt256(const uint32_t a[8], const uint32_t b[8]) {
+  for (int i = 0; i < 8; ++i) {
+    if (a[i] != b[i]) return a[i] < b[i];
+  }
+  return false;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Search [lower, upper] (inclusive, u32 nonces) of an 80-byte header
+// whose first 76 bytes are `header76` for the FIRST nonce whose
+// double-SHA-256 hash value is <= target (8 msb-first u32 words),
+// tracking the exact running minimum.
+//
+// Returns 1 if a winner was found, else 0. Outputs:
+//   out_nonce      — winning nonce, or the argmin nonce when none won
+//   out_hash[8]    — that nonce's hash value words (msb-first)
+//   out_searched   — nonces examined (early exit counts its prefix)
+//
+// The midstate of the first 64 header bytes is compressed once; per
+// nonce only the 16-byte tail block + the second hash run (the same
+// specialization the device templates use, ops/sha256.py).
+int sha256d_search(const uint8_t* header76, uint32_t lower, uint32_t upper,
+                   const uint32_t* target, uint32_t* out_nonce,
+                   uint32_t* out_hash, uint64_t* out_searched) {
+  uint32_t mid[8];
+  std::memcpy(mid, H0, sizeof(mid));
+  uint32_t w[16];
+  for (int i = 0; i < 16; ++i) w[i] = load_be(header76 + 4 * i);
+  compress(mid, w);
+
+  // constant part of the tail block: bytes 64..76 + padding for 80 bytes
+  uint32_t tail[16] = {0};
+  tail[0] = load_be(header76 + 64);
+  tail[1] = load_be(header76 + 68);
+  tail[2] = load_be(header76 + 72);
+  // tail[3] = nonce (little-endian bytes read big-endian = bswap)
+  tail[4] = 0x80000000u;
+  tail[15] = 640;
+
+  uint32_t second[16] = {0};
+  second[8] = 0x80000000u;
+  second[15] = 256;
+
+  uint32_t best[8];
+  std::memset(best, 0xFF, sizeof(best));
+  uint32_t best_nonce = lower;
+  uint64_t searched = 0;
+
+  for (uint64_t n = lower; n <= upper; ++n) {
+    uint32_t st[8];
+    std::memcpy(st, mid, sizeof(st));
+    tail[3] = bswap(uint32_t(n));
+    compress(st, tail);
+    std::memcpy(second, st, 8 * sizeof(uint32_t));
+    uint32_t st2[8];
+    std::memcpy(st2, H0, sizeof(st2));
+    compress(st2, second);
+    uint32_t hv[8];
+    for (int i = 0; i < 8; ++i) hv[i] = bswap(st2[7 - i]);
+    ++searched;
+    if (lt256(hv, best)) {
+      std::memcpy(best, hv, sizeof(best));
+      best_nonce = uint32_t(n);
+      if (!lt256(target, hv)) {  // hv <= target: first winner ends it
+        *out_nonce = uint32_t(n);
+        std::memcpy(out_hash, hv, sizeof(best));
+        *out_searched = searched;
+        return 1;
+      }
+    }
+  }
+  *out_nonce = best_nonce;
+  std::memcpy(out_hash, best, sizeof(best));
+  *out_searched = searched;
+  return 0;
+}
+
+// Toy dialect (reference parity): minimize the 64-bit fold (first 8
+// digest bytes, big-endian) of SHA-256(data ‖ nonce_be8) over
+// [lower, upper]. Writes the argmin nonce and fold value.
+void toy_min_search(const uint8_t* data, uint64_t len, uint64_t lower,
+                    uint64_t upper, uint64_t* out_nonce, uint64_t* out_fold) {
+  // message = data ‖ 8 nonce bytes; full padding recomputed per nonce is
+  // wasteful, so precompute the midstate of all whole 64-byte blocks
+  // that contain no nonce bytes.
+  uint64_t msg_len = len + 8;
+  uint64_t n_whole = len / 64;  // blocks fully before the nonce bytes? only
+  // blocks entirely within data[0 : len - (len % 64)] are constant iff
+  // they end at or before len rounded down AND before the nonce start.
+  // The nonce begins at byte `len`, so all blocks ending <= len are
+  // constant only when 64*k <= len. (len % 64 == 0 edge included.)
+  uint32_t mid[8];
+  std::memcpy(mid, H0, sizeof(mid));
+  uint64_t const_bytes = n_whole * 64;
+  uint32_t w[16];
+  for (uint64_t b = 0; b < n_whole; ++b) {
+    for (int i = 0; i < 16; ++i) w[i] = load_be(data + b * 64 + 4 * i);
+    compress(mid, w);
+  }
+  // assemble the variable tail (data remainder ‖ nonce ‖ pad ‖ len)
+  uint64_t rem = len - const_bytes;
+  uint64_t tail_len = msg_len - const_bytes;     // bytes of real message
+  uint64_t padded = ((tail_len + 8) / 64 + 1) * 64;  // 0x80 + u64 length
+  uint8_t buf[192];  // rem <= 63, +8 nonce, +pad: <= 135 < 192
+  uint64_t best_fold = ~0ull;
+  uint64_t best_nonce = lower;
+  for (uint64_t n = lower;; ++n) {
+    std::memset(buf, 0, sizeof(buf));
+    std::memcpy(buf, data + const_bytes, rem);
+    for (int i = 0; i < 8; ++i) buf[rem + i] = uint8_t(n >> (56 - 8 * i));
+    buf[tail_len] = 0x80;
+    uint64_t bits = msg_len * 8;
+    for (int i = 0; i < 8; ++i)
+      buf[padded - 8 + i] = uint8_t(bits >> (56 - 8 * i));
+    uint32_t st[8];
+    std::memcpy(st, mid, sizeof(st));
+    for (uint64_t b = 0; b < padded / 64; ++b) {
+      for (int i = 0; i < 16; ++i) w[i] = load_be(buf + b * 64 + 4 * i);
+      compress(st, w);
+    }
+    uint64_t fold = (uint64_t(st[0]) << 32) | st[1];
+    if (fold < best_fold) {
+      best_fold = fold;
+      best_nonce = n;
+    }
+    if (n == upper) break;  // upper may be UINT64_MAX: no n<=upper loop
+  }
+  *out_nonce = best_nonce;
+  *out_fold = best_fold;
+}
+
+}  // extern "C"

@@ -304,11 +304,14 @@ def test_smoke_runners_roundtrip(capsys):
     from tpuminter.lsp import crunner, srunner
 
     async def scenario():
-        server = asyncio.create_task(srunner.serve(47391))
-        await asyncio.sleep(0.2)
+        port_ready = asyncio.get_running_loop().create_future()
+        server = asyncio.create_task(
+            srunner.serve(0, on_ready=port_ready.set_result)
+        )
+        port = await asyncio.wait_for(port_ready, 5.0)
         try:
             await asyncio.wait_for(
-                crunner.run("127.0.0.1", 47391, ["alpha", "beta"]), 10.0
+                crunner.run("127.0.0.1", port, ["alpha", "beta"]), 10.0
             )
         finally:
             server.cancel()

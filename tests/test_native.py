@@ -1,0 +1,146 @@
+"""Native C++ miner tests: the compiled core (native/sha256d.cc) is
+pinned bit-for-bit to the Python/hashlib reference semantics across
+every dialect, then driven end-to-end through the real cluster.
+
+The shared library is built on demand (``make -C native``); tests skip
+only if no C++ toolchain exists (it does in this image).
+"""
+
+import struct
+import subprocess
+
+import numpy as np
+import pytest
+
+from tpuminter import chain
+from tpuminter.protocol import PowMode, Request
+from tpuminter.worker import CpuMiner
+
+GEN = chain.GENESIS_HEADER
+
+
+@pytest.fixture(scope="module")
+def native_miner():
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        subprocess.run(
+            ["make", "-C", os.path.join(root, "native")],
+            check=True, capture_output=True, timeout=120,
+        )
+    except (FileNotFoundError, subprocess.CalledProcessError) as exc:
+        pytest.skip(f"cannot build native core: {exc}")
+    from tpuminter.native_worker import NativeMiner
+
+    return NativeMiner(batch=1 << 14)
+
+
+def _drain(gen):
+    result = None
+    for item in gen:
+        if item is not None:
+            result = item
+    return result
+
+
+def test_native_finds_genesis(native_miner):
+    req = Request(
+        job_id=1, mode=PowMode.TARGET, lower=GEN.nonce - 20_000,
+        upper=GEN.nonce + 20_000, header=GEN.pack(),
+        target=chain.bits_to_target(GEN.bits),
+    )
+    result = _drain(native_miner.mine(req))
+    assert result.found
+    assert result.nonce == GEN.nonce
+    assert result.hash_value == GEN.block_hash_int()
+    assert result.searched == 20_001  # first-winner early exit
+
+
+def test_native_exhausted_matches_cpu(native_miner):
+    req = Request(job_id=2, mode=PowMode.TARGET, lower=100, upper=5099,
+                  header=GEN.pack(), target=1)
+    want = _drain(CpuMiner(batch=1024).mine(req))
+    got = _drain(native_miner.mine(req))
+    assert not got.found
+    assert (got.nonce, got.hash_value) == (want.nonce, want.hash_value)
+    assert got.searched == want.searched == 5000
+
+
+def test_native_min_matches_cpu(native_miner):
+    req = Request(job_id=3, mode=PowMode.MIN, lower=7, upper=9006,
+                  data=b"native parity")
+    want = _drain(CpuMiner(batch=1024).mine(req))
+    got = _drain(native_miner.mine(req))
+    assert (got.nonce, got.hash_value) == (want.nonce, want.hash_value)
+
+
+def test_native_min_data_straddles_block(native_miner):
+    """Toy data >64 bytes: the midstate path in toy_min_search."""
+    data = bytes(range(100))
+    req = Request(job_id=4, mode=PowMode.MIN, lower=0, upper=2000, data=data)
+    want = _drain(CpuMiner(batch=512).mine(req))
+    got = _drain(native_miner.mine(req))
+    assert (got.nonce, got.hash_value) == (want.nonce, want.hash_value)
+
+
+def test_native_rolled_matches_cpu(native_miner):
+    rng = np.random.RandomState(3)
+    prefix, suffix = rng.bytes(41), rng.bytes(60)
+    branch = (rng.bytes(32), rng.bytes(32))
+    nb, ens = 9, 3
+    base = dict(
+        job_id=5, mode=PowMode.TARGET, lower=10, upper=(ens << nb) - 5,
+        header=GEN.pack(), coinbase_prefix=prefix, coinbase_suffix=suffix,
+        extranonce_size=4, branch=branch, nonce_bits=nb,
+    )
+    # exhausted: exact min over the rolled space
+    want = _drain(CpuMiner(batch=256).mine(Request(target=1, **base)))
+    got = _drain(native_miner.mine(Request(target=1, **base)))
+    assert (got.nonce, got.hash_value) == (want.nonce, want.hash_value)
+    # found: first winner at the known min
+    req = Request(target=want.hash_value, **base)
+    got = _drain(native_miner.mine(req))
+    assert got.found
+    assert (got.nonce, got.hash_value) == (want.nonce, want.hash_value)
+
+
+def test_native_scrypt_delegates(native_miner):
+    hdr = GEN.pack()
+    h_min, n_min = min(
+        (chain.hash_to_int(chain.scrypt_hash(hdr[:76] + struct.pack("<I", n))), n)
+        for n in range(51)
+    )
+    req = Request(job_id=6, mode=PowMode.SCRYPT, lower=0, upper=50,
+                  header=hdr, target=h_min)
+    result = _drain(native_miner.mine(req))
+    assert result.found
+    assert (result.nonce, result.hash_value) == (n_min, h_min)
+
+
+def test_native_through_cluster(native_miner):
+    from tests.test_e2e import FAST, Cluster, run
+    from tpuminter.client import submit
+
+    async def scenario():
+        cluster = await Cluster.create(
+            n_miners=1, chunk_size=16384,
+            miner_factory=lambda: native_miner,
+        )
+        try:
+            req = Request(
+                job_id=9, mode=PowMode.TARGET, lower=GEN.nonce - 30_000,
+                upper=GEN.nonce + 30_000, header=GEN.pack(),
+                target=chain.bits_to_target(GEN.bits),
+            )
+            result = await submit(
+                "127.0.0.1", cluster.coord.port, req, params=FAST
+            )
+            assert result.found and result.nonce == GEN.nonce
+            assert cluster.coord.stats["results_rejected"] == 0
+            stats = cluster.coord.worker_stats()
+            assert list(s["backend"] for s in stats.values()) == ["native"]
+        finally:
+            await cluster.close()
+
+    run(scenario())

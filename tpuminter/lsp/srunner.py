@@ -26,10 +26,12 @@ from tpuminter.lsp.params import FAST
 log = logging.getLogger("tpuminter.lsp.srunner")
 
 
-async def serve(port: int, drop_pct: float = 0.0) -> None:
+async def serve(port: int, drop_pct: float = 0.0, on_ready=None) -> None:
     server = await LspServer.create(port, FAST)
     if drop_pct:
         server.endpoint.set_read_drop_rate(drop_pct / 100.0)
+    if on_ready is not None:
+        on_ready(server.port)  # port 0 binds ephemerally; report it
     log.info("echo server on port %d (drop=%.0f%%)", server.port, drop_pct)
     try:
         while True:

@@ -229,17 +229,35 @@ class ProfiledMiner(Miner):
         import jax
 
         step = 0
-        for item in self._inner.mine(request):
-            step += 1
-            if step == self._START_STEP and not self._traced:
-                log.info("profiling steady-state window to %s", self._log_dir)
-                jax.profiler.start_trace(self._log_dir)
-                self._tracing = True
-                self._traced = True
-            elif step == self._STOP_STEP and self._tracing:
+        try:
+            for item in self._inner.mine(request):
+                step += 1
+                if step == self._START_STEP and not self._traced:
+                    log.info(
+                        "profiling steady-state window to %s", self._log_dir
+                    )
+                    jax.profiler.start_trace(self._log_dir)
+                    self._tracing = True
+                    self._traced = True
+                elif step == self._STOP_STEP and self._tracing:
+                    self._stop_trace()
+                yield item
+        except BaseException:
+            # exceptions propagate on the executor thread — safe (and
+            # necessary) to serialize the trace here before re-raising
+            if self._tracing:
                 self._stop_trace()
-            yield item
+            raise
         if self._tracing:  # chunk ended inside the window
+            self._stop_trace()
+
+    def close(self) -> None:
+        """Flush a still-open trace at worker shutdown (``run_miner``'s
+        finally): heartbeats no longer matter then, so serializing on
+        the caller's thread is fine. Covers the Cancel-then-exit path
+        where no further ``mine()`` call would ever close it."""
+        if self._tracing:
+            log.info("flushing open trace at shutdown")
             self._stop_trace()
 
 
@@ -320,6 +338,9 @@ async def run_miner(
     finally:
         if read_task is not None:
             read_task.cancel()
+        closer = getattr(miner, "close", None)
+        if callable(closer):
+            closer()  # e.g. ProfiledMiner flushes a still-open trace
         await client.close(drain_timeout=2.0)
 
 
@@ -368,7 +389,13 @@ def _build_miner(
         if depth is not None:
             kwargs["depth"] = depth
         return PodMiner(**kwargs)
-    raise SystemExit(f"unknown backend {backend!r} (expected cpu|jax|tpu|pod)")
+    if backend == "native":
+        from tpuminter.native_worker import NativeMiner
+
+        return NativeMiner()
+    raise SystemExit(
+        f"unknown backend {backend!r} (expected cpu|jax|tpu|pod|native)"
+    )
 
 
 def main(argv: Optional[list] = None) -> None:
@@ -380,8 +407,8 @@ def main(argv: Optional[list] = None) -> None:
     parser.add_argument("hostport", help="coordinator address, host:port")
     parser.add_argument(
         "--backend", default="cpu",
-        help="cpu|jax|tpu|pod (default cpu; pod drives every chip of "
-        "the local slice as one worker)",
+        help="cpu|jax|tpu|pod|native (default cpu; pod drives every chip "
+        "of the local slice as one worker; native is the compiled C++ loop)",
     )
     parser.add_argument(
         "--exact-min", action="store_true",
