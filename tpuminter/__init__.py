@@ -17,6 +17,12 @@ Architecture (two planes, SURVEY.md §7):
   (``tpuminter.ops``, ``tpuminter.kernels``, ``tpuminter.parallel``), with
   an ICI or-reduce for pod-wide early exit and on-device extraNonce /
   Merkle-root rolling.
+
+Worker backends behind the one ``Miner`` interface: ``cpu`` (Python
+reference loop), ``native`` (compiled C++ core, ``native/``), ``jax``
+(jnp ops), ``tpu`` (Pallas kernels, one chip), ``pod`` (whole slice).
+Dialects: the reference's toy min-hash, real Bitcoin double-SHA target
+mining with extranonce rolling, and RFC 7914 scrypt (see ``protocol``).
 """
 
 __version__ = "0.1.0"
