@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import asyncio
 from collections import OrderedDict, deque
-from typing import Callable, Deque, Dict, Optional
+from typing import Callable, Deque, Dict
 
 from tpuminter.lsp.message import Frame, MsgType
 from tpuminter.lsp.params import Params

@@ -22,7 +22,7 @@ on a real TPU slice and on the fake 8-device CPU mesh CI uses
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Sequence, Tuple
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
