@@ -212,3 +212,32 @@ def test_pod_miner_through_cluster(mesh):
             await cluster.close()
 
     run(scenario())
+
+
+def test_pod_miner_scrypt_sharded(mesh):
+    """SCRYPT sharded over the mesh: pod result ≡ CpuMiner, winner and
+    exhausted-minimum both, including a ragged tail below one pod span."""
+    import struct
+
+    hdr = GEN.pack()
+    prefix = hdr[:76]
+    upper = 8 * 64 + 37  # one full pod span (8 dev × 64) + ragged tail
+    all_h = [
+        (chain.hash_to_int(chain.scrypt_hash(prefix + struct.pack("<I", n))), n)
+        for n in range(upper + 1)
+    ]
+    h_min, n_min = min(all_h)
+    miner = PodMiner(mesh=mesh, slab_per_device=256, n_slabs=2, kernel="jnp")
+
+    req = Request(job_id=21, mode=PowMode.SCRYPT, lower=0, upper=upper,
+                  header=hdr, target=h_min)
+    result = _drain(miner.mine(req))
+    assert result.found
+    assert (result.nonce, result.hash_value) == (n_min, h_min)
+
+    req = Request(job_id=22, mode=PowMode.SCRYPT, lower=0, upper=upper,
+                  header=hdr, target=1)
+    result = _drain(miner.mine(req))
+    assert not result.found
+    assert (result.hash_value, result.nonce) == (h_min, n_min)
+    assert result.searched == upper + 1

@@ -13,6 +13,7 @@ Joining the coordinator like any other miner.
 from tpuminter.parallel.mesh import (
     build_candidate_sweep,
     build_min_fold,
+    build_scrypt_sweep,
     build_target_sweep,
     make_mesh,
 )
@@ -22,4 +23,5 @@ __all__ = [
     "build_target_sweep",
     "build_min_fold",
     "build_candidate_sweep",
+    "build_scrypt_sweep",
 ]

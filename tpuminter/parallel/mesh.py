@@ -282,6 +282,66 @@ def build_candidate_sweep(
     return jax.jit(sharded)
 
 
+def build_scrypt_sweep(
+    mesh: Mesh,
+    *,
+    batch_per_device: int,
+    n_log2: int = 10,
+) -> Callable:
+    """Compile a pod-wide SCRYPT-mode batch step (BASELINE.json:11 at
+    slice scale): device ``d`` hashes the contiguous batch starting at
+    ``start + d · batch_per_device`` through the jnp scrypt pipeline
+    (``ops.scrypt.scrypt_header_batch`` — header words are runtime
+    values, one compile serves every job and extranonce), then the pod
+    folds a winner flag (or-reduce), the first winning nonce (pmin),
+    and the running lexicographic minimum (all_gather + argmin) over
+    ICI.
+
+    Returns ``step(header76w_u32x19, start_u32, target_words_u32x8) ->
+    (found_u32, win_nonce_u32, win_digest_u32x8, min_digest_u32x8,
+    min_nonce_u32)`` — replicated. The host loops steps across a chunk
+    (scrypt has no candidate trick: the full hash is the test, so each
+    step is an exact sweep of ``n_dev × batch_per_device`` nonces).
+    Memory: ``batch_per_device × 128·2^n_log2`` bytes of V per chip.
+    """
+
+    def per_device(hw19, start, target_words):
+        from tpuminter.ops import scrypt as scrypt_ops
+
+        d = lax.axis_index(AXIS).astype(jnp.uint32)
+        nonces = (
+            start + d * np.uint32(batch_per_device)
+            + jnp.arange(batch_per_device, dtype=jnp.uint32)
+        )
+        digests = scrypt_ops.scrypt_header_batch(hw19, nonces, n_log2)
+        hw = ops.hash_words_be(digests)
+        ok = ops.lex_le(hw, target_words)
+        local_found = ok.any()
+        first = jnp.argmax(ok)
+        found = lax.pmax(local_found.astype(jnp.uint32), AXIS)
+        cand = jnp.where(local_found, nonces[first], np.uint32(0xFFFFFFFF))
+        win_nonce = lax.pmin(cand, AXIS)
+        is_winner = local_found & (cand == win_nonce)
+        win_digest = lax.psum(
+            jnp.where(is_winner, digests[first], np.uint32(0)), AXIS
+        )
+        midx = ops.lex_argmin(hw)
+        all_words = lax.all_gather(hw[midx], AXIS)       # (n_dev, 8)
+        all_digests = lax.all_gather(digests[midx], AXIS)
+        all_nonces = lax.all_gather(nonces[midx], AXIS)
+        bi = ops.lex_argmin(all_words)
+        return found, win_nonce, win_digest, all_digests[bi], all_nonces[bi]
+
+    sharded = jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(), P(), P()),
+        out_specs=(P(),) * 5,
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
 def build_min_fold(
     mesh: Mesh,
     template: ops.NonceTemplate,

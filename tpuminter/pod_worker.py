@@ -20,10 +20,12 @@ Dialect routing:
   roll itself on device (``ops.merkle.make_extranonce_roll``).
 - **MIN** folds through ``parallel.build_min_fold`` (pod-wide argmin
   over ICI), host-looped per step like the reference's chunk fold.
-- **SCRYPT** delegates to the single-chip jnp pipeline: its ROMix is
-  HBM-bound per chip and its batch already saturates one chip's HBM;
-  sharding it over a mesh is a straight data-parallel extension left
-  with the (documented) single-chip scrypt path.
+- **SCRYPT** shards data-parallel over the mesh
+  (``parallel.build_scrypt_sweep``): each chip hashes a contiguous
+  batch through the jnp scrypt pipeline (ROMix is HBM-bound per chip,
+  so per-chip batches saturate per-chip bandwidth and chips scale
+  linearly), with winner/min folds over ICI; ragged tails run through
+  the single-chip path.
 
 Like TpuMiner's fast path, exhausted TARGET ranges report the exact
 minimum only when a candidate surfaced (``protocol.MIN_UNTRACKED``
@@ -97,6 +99,7 @@ class PodMiner(Miner):
         )
         self._sweep_static = None  # compiled pod programs, built lazily
         self._sweep_dyn = None
+        self._scrypt_sweep = None
         self._template = None
         self._jax_delegate = None
 
@@ -288,11 +291,86 @@ class PodMiner(Miner):
             searched=req.upper - req.lower + 1, chunk_id=req.chunk_id,
         )
 
-    # -- SCRYPT: single-chip delegate --------------------------------------
+    # -- SCRYPT: pod data-parallel sweep -----------------------------------
 
     def _mine_scrypt(self, req: Request) -> Iterator[Optional[Result]]:
+        """Memory-hard dialect sharded over the mesh: each chip hashes a
+        contiguous batch through the jnp scrypt pipeline and the winner/
+        min folds ride ICI (``parallel.build_scrypt_sweep``). Rolled
+        jobs reuse the host-rolled segment iterator (one roll per
+        2^nonce_bits hashes is noise at scrypt rates)."""
         from tpuminter.jax_worker import JaxMiner
+        from tpuminter.ops import scrypt as scrypt_ops
+        from tpuminter.parallel import build_scrypt_sweep
 
-        yield from JaxMiner(
-            scrypt_batch=16384 if jax.default_backend() != "cpu" else 256
-        )._mine_scrypt(req)
+        assert req.target is not None
+        bpd = 16384 if jax.default_backend() != "cpu" else 64
+        if self._scrypt_sweep is None:
+            self._scrypt_sweep = build_scrypt_sweep(
+                self.mesh, batch_per_device=bpd
+            )
+        step = self._scrypt_sweep
+        span = self.n_dev * bpd
+        target_words = jnp.asarray(ops.target_to_words(req.target))
+        delegate = JaxMiner(scrypt_batch=bpd)
+        best: Optional[Tuple[int, int]] = None  # (hash, global index)
+        searched = 0
+        for hdr76, base_g, lo, hi in delegate._scrypt_segments(req):
+            hw19 = jnp.asarray(scrypt_ops.header_to_words(hdr76))
+            nonce = lo
+            while nonce <= hi:
+                take = min(span, hi - nonce + 1)
+                if take < span:
+                    # ragged tail: the pod step has a fixed span, so the
+                    # remainder runs through the single-chip path (same
+                    # pipeline, smaller batch shape)
+                    sub = Request(
+                        job_id=req.job_id, mode=req.mode, lower=nonce,
+                        upper=hi, header=hdr76 + bytes(4),
+                        target=req.target, chunk_id=req.chunk_id,
+                    )
+                    tail_result: Optional[Result] = None
+                    for item in delegate._mine_scrypt(sub):
+                        if item is None:
+                            yield None
+                        else:
+                            tail_result = item
+                    assert tail_result is not None
+                    searched += tail_result.searched
+                    if tail_result.found:
+                        yield Result(
+                            req.job_id, req.mode, base_g | tail_result.nonce,
+                            tail_result.hash_value, found=True,
+                            searched=searched, chunk_id=req.chunk_id,
+                        )
+                        return
+                    cand = (tail_result.hash_value, base_g | tail_result.nonce)
+                    if best is None or cand < best:
+                        best = cand
+                    break
+                found, win_nonce, win_digest, min_digest, min_nonce = step(
+                    hw19, jnp.uint32(nonce), target_words
+                )
+                if int(found):
+                    g = base_g | int(win_nonce)
+                    h = ops.digest_to_int(np.asarray(win_digest))
+                    yield Result(
+                        req.job_id, req.mode, g, h, found=True,
+                        searched=searched + (int(win_nonce) - nonce + 1),
+                        chunk_id=req.chunk_id,
+                    )
+                    return
+                cand = (
+                    ops.digest_to_int(np.asarray(min_digest)),
+                    base_g | int(min_nonce),
+                )
+                if best is None or cand < best:
+                    best = cand
+                searched += take
+                nonce += take
+                yield None
+        yield Result(
+            req.job_id, req.mode, best[1], best[0],
+            found=best[0] <= req.target,
+            searched=searched, chunk_id=req.chunk_id,
+        )
