@@ -520,3 +520,71 @@ def test_chaos_drops_deaths_and_concurrent_clients():
             await cluster.close()
 
     run(scenario(), timeout=120.0)
+
+
+def test_mixed_fleet_heterogeneous_backends():
+    """A job split across cpu + jax miners (different backends, one
+    interface — BASELINE.json:5's mixed-fleet story): the fold across
+    heterogeneous workers must still be exact."""
+    from tpuminter.jax_worker import JaxMiner
+
+    async def scenario():
+        cluster = await Cluster.create(n_miners=0, chunk_size=1500)
+        await cluster.add_miner(CpuMiner(batch=256))
+        await cluster.add_miner(JaxMiner(batch=1 << 12, lanes=1))
+        try:
+            req = Request(job_id=1, mode=PowMode.MIN, lower=0, upper=11_999,
+                          data=b"mixed fleet")
+            result = await submit(
+                "127.0.0.1", cluster.coord.port, req, params=FAST
+            )
+            assert (result.hash_value, result.nonce) == brute_min(
+                b"mixed fleet", 0, 11_999
+            )
+            stats = cluster.coord.worker_stats()
+            assert sorted(s["backend"] for s in stats.values()) == ["cpu", "jax"]
+            # both backends did verified work
+            assert all(s["hashes"] > 0 for s in stats.values())
+        finally:
+            await cluster.close()
+
+    run(scenario())
+
+
+def test_pod_worker_death_requeues_to_cpu():
+    """A whole-slice worker dying is just a (big) worker death: its
+    chunk requeues and a surviving CPU miner completes the job — the
+    slice-level failure-domain story (SURVEY.md §5)."""
+    import jax as _jax
+
+    if len(_jax.devices()) < 8:
+        pytest.skip("needs the fake 8-device CPU mesh")
+    from tpuminter.parallel import make_mesh
+    from tpuminter.pod_worker import PodMiner
+
+    async def scenario():
+        mesh = make_mesh(_jax.devices()[:8])
+        cluster = await Cluster.create(n_miners=0, chunk_size=2000)
+        await cluster.add_miner(
+            PodMiner(mesh=mesh, slab_per_device=128, n_slabs=2, kernel="jnp")
+        )
+        await cluster.add_miner(CpuMiner(batch=256))
+        try:
+            req = Request(job_id=1, mode=PowMode.MIN, lower=0, upper=9999,
+                          data=b"pod dies")
+            job = asyncio.ensure_future(
+                submit("127.0.0.1", cluster.coord.port, req, params=FAST)
+            )
+            await asyncio.sleep(0.2)
+            assert not job.done(), "job finished before the kill landed"
+            await cluster.kill_miner(0)  # the whole "slice" goes down
+            result = await asyncio.wait_for(job, 60.0)
+            assert (result.hash_value, result.nonce) == brute_min(
+                b"pod dies", 0, 9999
+            )
+            # the death really cost a chunk (not an idle-miner kill)
+            assert cluster.coord.stats["chunks_requeued"] >= 1
+        finally:
+            await cluster.close()
+
+    run(scenario())

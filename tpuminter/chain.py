@@ -27,6 +27,7 @@ from typing import Iterable, Iterator, List, Sequence, Tuple
 __all__ = [
     "sha256",
     "dsha256",
+    "scrypt_hash",
     "sha256_compress",
     "midstate",
     "bits_to_target",
@@ -43,6 +44,7 @@ __all__ = [
     "CoinbaseTemplate",
     "rolled_header",
     "split_global",
+    "rolled_segments",
     "HEADER_SIZE",
     "SHA256_H0",
     "SHA256_K",
