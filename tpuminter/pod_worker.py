@@ -92,10 +92,13 @@ class PodMiner(Miner):
         self.depth = depth
         self.kernel = kernel
         self.tiles_per_step = tiles_per_step
-        # scheduler hint: a pod advertises per-chip throughput × chips
+        # scheduler hint: a pod advertises per-chip throughput × chips,
+        # floored at one lane per chip (tiny test slabs underflow the
+        # integer division to 0, which the coordinator would clamp to a
+        # single-CPU-sized hint)
         self.lanes = (
             lanes if lanes is not None
-            else self.n_dev * (slab_per_device * 4) // 16_384
+            else max(self.n_dev, self.n_dev * (slab_per_device * 4) // 16_384)
         )
         self._sweep_static = None  # compiled pod programs, built lazily
         self._sweep_dyn = None
