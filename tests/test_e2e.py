@@ -45,8 +45,11 @@ class Cluster:
         self.miner_tasks = []
 
     @classmethod
-    async def create(cls, n_miners=1, chunk_size=4096, miner_factory=CpuMiner):
-        coord = await Coordinator.create(params=FAST, chunk_size=chunk_size)
+    async def create(cls, n_miners=1, chunk_size=4096, miner_factory=CpuMiner,
+                     **coord_kwargs):
+        coord = await Coordinator.create(
+            params=FAST, chunk_size=chunk_size, **coord_kwargs
+        )
         self = cls(coord)
         for _ in range(n_miners):
             await self.add_miner(miner_factory())
@@ -584,6 +587,71 @@ def test_pod_worker_death_requeues_to_cpu():
             )
             # the death really cost a chunk (not an idle-miner kill)
             assert cluster.coord.stats["chunks_requeued"] >= 1
+        finally:
+            await cluster.close()
+
+    run(scenario())
+
+
+def test_straggler_hedging_rescues_slow_chunk():
+    """Opt-in speculative backup dispatch: a chunk stuck on a stalled
+    miner is duplicated onto idle capacity once nothing else is queued,
+    the backup's verified Result wins, and the straggler is released
+    with a Cancel — the job completes exactly despite a worker that
+    never answers."""
+    import time as _time
+
+    from tpuminter.worker import Miner
+
+    class StallMiner(Miner):
+        backend = "cpu"
+        lanes = 1
+
+        def mine(self, request):
+            while True:
+                _time.sleep(0.05)  # forever "mining", never a Result
+                yield None
+
+    async def scenario():
+        cluster = await Cluster.create(
+            n_miners=0, chunk_size=3000, hedge_after=0.3
+        )
+        await cluster.add_miner(StallMiner())       # gets chunk [0, 2999]
+        await cluster.add_miner(CpuMiner(batch=256))
+        try:
+            req = Request(job_id=1, mode=PowMode.MIN, lower=0, upper=5999,
+                          data=b"hedge me")
+            result = await asyncio.wait_for(
+                submit("127.0.0.1", cluster.coord.port, req, params=FAST),
+                30.0,
+            )
+            assert (result.hash_value, result.nonce) == brute_min(
+                b"hedge me", 0, 5999
+            )
+            assert cluster.coord.stats["chunks_hedged"] >= 1
+        finally:
+            await cluster.close()
+
+    run(scenario())
+
+
+def test_hedging_disabled_by_default_no_duplicates():
+    """Without hedge_after, accounting stays exact (no duplicated
+    work): the original semantics are untouched by the feature."""
+
+    async def scenario():
+        cluster = await Cluster.create(n_miners=2, chunk_size=1024)
+        try:
+            req = Request(job_id=1, mode=PowMode.MIN, lower=0, upper=20_000,
+                          data=b"no hedge")
+            result = await submit(
+                "127.0.0.1", cluster.coord.port, req, params=FAST
+            )
+            assert (result.hash_value, result.nonce) == brute_min(
+                b"no hedge", 0, 20_000
+            )
+            assert cluster.coord.stats["hashes"] == 20_001
+            assert cluster.coord.stats["chunks_hedged"] == 0
         finally:
             await cluster.close()
 

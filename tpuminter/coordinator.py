@@ -84,6 +84,7 @@ class _MinerState:
     #: it answers: after a Cancel races a completion, a stale Result must
     #: not clobber the miner's next assignment.
     chunk: Optional[Tuple[int, int, int, int]] = None
+    chunk_at: float = 0.0  # monotonic dispatch time of `chunk`
     rejections: int = 0
     #: per-worker observability (SURVEY.md §5): verified work only
     hashes: int = 0
@@ -136,9 +137,28 @@ class _Job:
 class Coordinator:
     """The scheduler. Owns an :class:`LspServer`; drive with :meth:`serve`."""
 
-    def __init__(self, server: LspServer, *, chunk_size: int = DEFAULT_CHUNK_SIZE):
+    def __init__(
+        self,
+        server: LspServer,
+        *,
+        chunk_size: int = DEFAULT_CHUNK_SIZE,
+        hedge_after: Optional[float] = None,
+    ):
         self._server = server
         self._chunk_size = chunk_size
+        #: straggler hedging (speculative backup dispatch, the classic
+        #: MapReduce backup-task move): when idle miners have NOTHING
+        #: queued and an in-flight chunk has aged past ``hedge_after``
+        #: seconds, a duplicate dispatch of that chunk goes to an idle
+        #: miner; the first verified Result wins, the loser is Cancelled
+        #: and its stale answer dropped by chunk-id. ``None`` (default)
+        #: disables it — duplicated work inflates ``searched``-style
+        #: accounting, so it is an explicit operator opt-in.
+        if hedge_after is not None and hedge_after <= 0:
+            raise ValueError(
+                "hedge_after must be positive seconds (or None to disable)"
+            )
+        self._hedge_after = hedge_after
         self._miners: Dict[int, _MinerState] = {}
         self._clients: Dict[int, set] = {}        # client conn → its job_ids
         self._jobs: Dict[int, _Job] = {}
@@ -151,6 +171,7 @@ class Coordinator:
             "jobs_done": 0,
             "chunks_requeued": 0,
             "results_rejected": 0,
+            "chunks_hedged": 0,
         }
 
     @classmethod
@@ -161,9 +182,10 @@ class Coordinator:
         params: Optional[Params] = None,
         chunk_size: int = DEFAULT_CHUNK_SIZE,
         host: str = "127.0.0.1",
+        hedge_after: Optional[float] = None,
     ) -> "Coordinator":
         server = await LspServer.create(port, params or FAST, host=host)
-        return cls(server, chunk_size=chunk_size)
+        return cls(server, chunk_size=chunk_size, hedge_after=hedge_after)
 
     @property
     def port(self) -> int:
@@ -177,24 +199,43 @@ class Coordinator:
 
     async def serve(self) -> None:
         """Process events forever (≙ reference server main loop, §3.3)."""
+        ticker = None
+        if self._hedge_after is not None:
+            # the scheduler is otherwise purely event-driven; hedging
+            # needs a clock to notice a straggler when nothing else
+            # happens
+            ticker = asyncio.ensure_future(self._hedge_ticker())
+        try:
+            while True:
+                conn_id, payload = await self._server.read()
+                if payload is None:
+                    self._on_lost(conn_id)
+                    continue
+                try:
+                    msg = decode_msg(payload)
+                except ProtocolError as exc:
+                    log.warning(
+                        "conn %d: malformed message dropped: %s", conn_id, exc
+                    )
+                    continue
+                if isinstance(msg, Join):
+                    self._on_join(conn_id, msg)
+                elif isinstance(msg, Request):
+                    self._on_request(conn_id, msg)
+                elif isinstance(msg, Result):
+                    self._on_result(conn_id, msg)
+                else:
+                    log.warning(
+                        "conn %d: unexpected %s", conn_id, type(msg).__name__
+                    )
+        finally:
+            if ticker is not None:
+                ticker.cancel()
+
+    async def _hedge_ticker(self) -> None:
         while True:
-            conn_id, payload = await self._server.read()
-            if payload is None:
-                self._on_lost(conn_id)
-                continue
-            try:
-                msg = decode_msg(payload)
-            except ProtocolError as exc:
-                log.warning("conn %d: malformed message dropped: %s", conn_id, exc)
-                continue
-            if isinstance(msg, Join):
-                self._on_join(conn_id, msg)
-            elif isinstance(msg, Request):
-                self._on_request(conn_id, msg)
-            elif isinstance(msg, Result):
-                self._on_result(conn_id, msg)
-            else:
-                log.warning("conn %d: unexpected %s", conn_id, type(msg).__name__)
+            await asyncio.sleep(self._hedge_after / 2)
+            self._dispatch()
 
     async def close(self) -> None:
         await self._server.close(drain_timeout=2.0)
@@ -305,6 +346,8 @@ class Coordinator:
             miner.hashes += searched
             miner.chunks_done += 1
             miner.last_result = time.monotonic()
+            if self._hedge_after is not None:
+                self._settle_hedges(job, conn_id, lo, hi)
             job.fold(msg.hash_value, msg.nonce)
             if msg.found and job.request.mode.targeted:
                 self._finish_job(job, found=True)
@@ -319,6 +362,18 @@ class Coordinator:
     def _requeue_chunk(self, job: _Job, lo: int, hi: int) -> None:
         """Return a chunk to the front of its job's queue (the shared
         path for miner death and rejected results)."""
+        if any(
+            m.chunk is not None and m.chunk[1:] == (job.job_id, lo, hi)
+            for m in self._miners.values()
+        ):
+            # a hedge backup is already mining this exact range: a
+            # requeued third copy could be re-carved into sub-ranges the
+            # exact-match hedge settlement could never cancel
+            log.info(
+                "not requeueing [%d, %d] of job %d: a hedge copy is live",
+                lo, hi, job.job_id,
+            )
+            return
         job.ranges.appendleft((lo, hi))
         if job.job_id not in self._rotation:
             self._rotation.append(job.job_id)
@@ -448,40 +503,123 @@ class Coordinator:
                 continue
             miner = idle.popleft()
             lo, hi = job.ranges.popleft()
-            budget = self._chunk_size * miner.lanes
-            if job.request.mode == PowMode.SCRYPT:
-                budget = max(SCRYPT_MIN_CHUNK, budget // SCRYPT_CHUNK_DIVISOR)
-            take = min(hi - lo + 1, budget)
+            take = min(hi - lo + 1, self._budget(miner, job))
             chunk_hi = lo + take - 1
             if chunk_hi < hi:
                 job.ranges.appendleft((chunk_hi + 1, hi))
-            chunk_id = self._next_chunk_id
-            self._next_chunk_id += 1
-            miner.chunk = (chunk_id, job_id, lo, chunk_hi)
-            job.inflight[miner.conn_id] = (lo, chunk_hi)
-            req = job.request
-            try:
-                self._server.write(
-                    miner.conn_id,
-                    encode_msg(
-                        # the chunk Request is the client's Request with
-                        # the carved range + this dispatch's identity;
-                        # replace() keeps every dialect field (rolled
-                        # coinbase/branch, scrypt params, ...) intact
-                        dc_replace(
-                            req, job_id=job_id, lower=lo, upper=chunk_hi,
-                            chunk_id=chunk_id,
-                        )
-                    ),
-                )
-            except ConnectionError:
-                # lost between our bookkeeping and the write; undo
-                miner.chunk = None
-                job.inflight.pop(miner.conn_id, None)
+            if not self._assign(miner, job, lo, chunk_hi):
                 job.ranges.appendleft((lo, chunk_hi))
                 continue
             # rotate: next dispatch serves the next job
             self._rotation.rotate(-1)
+        if self._hedge_after is not None and idle:
+            self._hedge(idle)
+
+    def _budget(self, miner: _MinerState, job: _Job) -> int:
+        """Per-dispatch nonce budget for this (miner, dialect) pair."""
+        budget = self._chunk_size * miner.lanes
+        if job.request.mode == PowMode.SCRYPT:
+            budget = max(SCRYPT_MIN_CHUNK, budget // SCRYPT_CHUNK_DIVISOR)
+        return budget
+
+    def _assign(self, miner: _MinerState, job: _Job, lo: int, hi: int) -> bool:
+        """Book-keep + write one chunk dispatch; False if the write
+        failed (caller decides what to do with the range)."""
+        chunk_id = self._next_chunk_id
+        self._next_chunk_id += 1
+        miner.chunk = (chunk_id, job.job_id, lo, hi)
+        miner.chunk_at = time.monotonic()
+        job.inflight[miner.conn_id] = (lo, hi)
+        try:
+            self._server.write(
+                miner.conn_id,
+                encode_msg(
+                    # the chunk Request is the client's Request with the
+                    # carved range + this dispatch's identity; replace()
+                    # keeps every dialect field (rolled coinbase/branch,
+                    # scrypt params, ...) intact
+                    dc_replace(
+                        job.request, job_id=job.job_id, lower=lo, upper=hi,
+                        chunk_id=chunk_id,
+                    )
+                ),
+            )
+        except ConnectionError:
+            # lost between our bookkeeping and the write; undo
+            miner.chunk = None
+            job.inflight.pop(miner.conn_id, None)
+            return False
+        return True
+
+    def _hedge(self, idle: Deque[_MinerState]) -> None:
+        """Speculative backup dispatch for stragglers: with NOTHING
+        queued and idle capacity, duplicate the oldest over-age
+        in-flight chunk onto an idle miner (the MapReduce backup-task
+        move). The first verified Result wins (`_settle_hedges`); the
+        duplicate's Result arrives stale and is dropped, so correctness
+        is untouched — only duplicated work is spent, which is exactly
+        what idle capacity is."""
+        now = time.monotonic()
+        # ranges already dispatched to 2+ miners need no further hedging
+        seen: Dict[Tuple[int, int, int], int] = {}
+        for m in self._miners.values():
+            if m.chunk is not None:
+                _, job_id, lo, hi = m.chunk
+                seen[(job_id, lo, hi)] = seen.get((job_id, lo, hi), 0) + 1
+        candidates = sorted(
+            (
+                m for m in self._miners.values()
+                if m.chunk is not None
+                and now - m.chunk_at > self._hedge_after
+                and seen[(m.chunk[1], m.chunk[2], m.chunk[3])] == 1
+            ),
+            key=lambda m: m.chunk_at,
+        )
+        for straggler in candidates:
+            if not idle:
+                return
+            _, job_id, lo, hi = straggler.chunk
+            job = self._jobs.get(job_id)
+            if job is None or job.done:
+                continue
+            # the backup must be in the straggler's size class: handing a
+            # device-carved chunk to a lanes=1 CPU would create a far
+            # worse straggler. Pick the first idle miner whose own budget
+            # covers the chunk within a 4× stretch; skip otherwise.
+            size = hi - lo + 1
+            backup = next(
+                (m for m in idle if 4 * self._budget(m, job) >= size), None
+            )
+            if backup is None:
+                continue
+            idle.remove(backup)
+            if self._assign(backup, job, lo, hi):
+                self.stats["chunks_hedged"] += 1
+                log.info(
+                    "hedged straggler chunk [%d, %d] of job %d (miner %d, "
+                    "%.1fs in flight) onto idle miner %d",
+                    lo, hi, job_id, straggler.conn_id,
+                    now - straggler.chunk_at, backup.conn_id,
+                )
+
+    def _settle_hedges(self, job: _Job, winner_conn: int,
+                       lo: int, hi: int) -> None:
+        """A chunk Result was accepted: release any OTHER miner still
+        mining the same range (a hedge loser). Its eventual Result
+        fails the chunk-id match and is dropped, so nothing double
+        counts; the Cancel stops it burning device time."""
+        for m in self._miners.values():
+            if (
+                m.conn_id != winner_conn
+                and m.chunk is not None
+                and m.chunk[1:] == (job.job_id, lo, hi)
+            ):
+                m.chunk = None
+                job.inflight.pop(m.conn_id, None)
+                try:
+                    self._server.write(m.conn_id, encode_msg(Cancel(job.job_id)))
+                except ConnectionError:
+                    pass
 
 
 def main(argv: Optional[list] = None) -> None:
@@ -492,11 +630,20 @@ def main(argv: Optional[list] = None) -> None:
     parser = argparse.ArgumentParser(description="tpuminter coordinator (server role)")
     parser.add_argument("port", type=int)
     parser.add_argument("--chunk-size", type=int, default=DEFAULT_CHUNK_SIZE)
+    parser.add_argument(
+        "--hedge-after", type=float, default=None, metavar="SECONDS",
+        help="speculatively duplicate an in-flight chunk onto idle "
+        "capacity after this many seconds with nothing else queued "
+        "(off by default: hedged work double-counts in `searched`)",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
     async def _run() -> None:
-        coord = await Coordinator.create(args.port, chunk_size=args.chunk_size)
+        coord = await Coordinator.create(
+            args.port, chunk_size=args.chunk_size,
+            hedge_after=args.hedge_after,
+        )
         log.info("coordinator listening on port %d", coord.port)
         await coord.serve()
 
