@@ -64,19 +64,66 @@ def main(argv: Optional[list] = None) -> None:
     parser.add_argument("--scrypt", action="store_true",
                         help="with --header: scrypt PoW (Litecoin N=1024,r=1,p=1) "
                         "instead of double-SHA256")
+    parser.add_argument("--coinbase-prefix", metavar="HEX", default=None,
+                        help="extranonce rolling (eval configs 3-4): coinbase tx "
+                        "bytes before the extranonce; the search space becomes "
+                        "(extranonce x nonce) and workers re-roll the merkle "
+                        "root on device as each 2^32 nonce space exhausts")
+    parser.add_argument("--coinbase-suffix", metavar="HEX", default="",
+                        help="coinbase tx bytes after the extranonce")
+    parser.add_argument("--branch", metavar="HEX", action="append", default=[],
+                        help="32-byte merkle branch sibling, repeatable, "
+                        "leaf-to-root order")
+    parser.add_argument("--extranonce-size", type=int, default=4,
+                        help="extranonce width in bytes (1-8, default 4)")
+    parser.add_argument("--max-extranonce", type=int, default=None,
+                        help="with --coinbase-prefix: highest extranonce to "
+                        "search (default 255)")
     args = parser.parse_args(argv)
     host, _, port = args.hostport.rpartition(":")
     logging.basicConfig(level=logging.WARNING)
 
     if args.header is not None:
         header = bytes.fromhex(args.header)
+        rolled = {}
+        upper = args.max_nonce_opt
+        if args.coinbase_prefix is not None:
+            if args.max_nonce_opt != 0xFFFFFFFF:
+                parser.error(
+                    "--max-nonce conflicts with --coinbase-prefix: a rolled "
+                    "job sweeps full 2^32 nonce spaces per extranonce; bound "
+                    "it with --max-extranonce instead"
+                )
+            if not 1 <= args.extranonce_size <= 8:
+                parser.error("--extranonce-size must be in [1, 8]")
+            max_en = 255 if args.max_extranonce is None else args.max_extranonce
+            en_limit = (1 << min(32, 8 * args.extranonce_size)) - 1
+            if not 0 <= max_en <= en_limit:
+                parser.error(
+                    f"--max-extranonce must be in [0, {en_limit}] for "
+                    f"--extranonce-size {args.extranonce_size}"
+                )
+            for sib in args.branch:
+                if len(sib) != 64:
+                    parser.error(
+                        f"--branch entries must be 64 hex chars (32 bytes), "
+                        f"got {len(sib)}"
+                    )
+            upper = (max_en << 32) | 0xFFFFFFFF
+            rolled = dict(
+                coinbase_prefix=bytes.fromhex(args.coinbase_prefix),
+                coinbase_suffix=bytes.fromhex(args.coinbase_suffix),
+                extranonce_size=args.extranonce_size,
+                branch=tuple(bytes.fromhex(s) for s in args.branch),
+            )
         request = Request(
             job_id=1,
             mode=PowMode.SCRYPT if args.scrypt else PowMode.TARGET,
             lower=0,
-            upper=args.max_nonce_opt,
+            upper=upper,
             header=header,
             target=chain.bits_to_target(args.bits),
+            **rolled,
         )
     elif args.message is not None and args.max_nonce is not None:
         request = Request(
@@ -99,7 +146,14 @@ def main(argv: Optional[list] = None) -> None:
             print(f"Result {result.hash_value} {result.nonce}")
         elif result.found:
             digest = result.hash_value.to_bytes(32, "little")
-            print(f"Result {chain.hash_to_hex(digest)} {result.nonce}")
+            if request.rolled:
+                en, n = chain.split_global(result.nonce, request.nonce_bits)
+                print(
+                    f"Result {chain.hash_to_hex(digest)} "
+                    f"extranonce={en} nonce={n}"
+                )
+            else:
+                print(f"Result {chain.hash_to_hex(digest)} {result.nonce}")
         else:
             print("Exhausted (no nonce met the target)")
 
