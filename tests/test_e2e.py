@@ -656,3 +656,43 @@ def test_hedging_disabled_by_default_no_duplicates():
             await cluster.close()
 
     run(scenario())
+
+
+def test_one_client_connection_many_jobs():
+    """A single LSP connection may submit several Requests; each job's
+    final Result echoes the client's own job_id so answers can arrive
+    in any order and still be matched (the reference's client sends one
+    request, but the protocol — and our scheduler — supports many)."""
+    from tpuminter.lsp import LspClient
+    from tpuminter.protocol import Result as ResultMsg
+    from tpuminter.protocol import decode_msg, encode_msg
+
+    async def scenario():
+        cluster = await Cluster.create(n_miners=2, chunk_size=1024)
+        try:
+            conn = await LspClient.connect(
+                "127.0.0.1", cluster.coord.port, FAST
+            )
+            jobs = {
+                11: (b"multi-a", 9_000),
+                22: (b"multi-b", 4_000),
+                33: (b"multi-c", 6_500),
+            }
+            for jid, (data, upper) in jobs.items():
+                conn.write(encode_msg(Request(
+                    job_id=jid, mode=PowMode.MIN, lower=0, upper=upper,
+                    data=data,
+                )))
+            got = {}
+            while len(got) < len(jobs):
+                msg = decode_msg(await conn.read())
+                assert isinstance(msg, ResultMsg)
+                got[msg.job_id] = msg
+            await conn.close()
+            for jid, (data, upper) in jobs.items():
+                want = brute_min(data, 0, upper)
+                assert (got[jid].hash_value, got[jid].nonce) == want, jid
+        finally:
+            await cluster.close()
+
+    run(scenario())
