@@ -352,18 +352,23 @@ def test_forged_found_result_is_rejected_and_liar_evicted():
         try:
             from tpuminter.coordinator import MAX_REJECTIONS
             from tpuminter.lsp import LspClient
-            from tpuminter.protocol import Join, Result, decode_msg, encode_msg
+            from tpuminter.protocol import (
+                Assign, Join, Result, Setup, decode_msg, encode_msg,
+            )
 
             evil = await LspClient.connect("127.0.0.1", cluster.coord.port, FAST)
             evil.write(encode_msg(Join(backend="evil", lanes=1)))
 
             async def forge_forever():
-                # answer every Request with an impossible winner
+                # answer every dispatch with an impossible winner
+                modes = {}
                 while True:
                     msg = decode_msg(await evil.read())
-                    if isinstance(msg, Request):
+                    if isinstance(msg, Setup):
+                        modes[msg.request.job_id] = msg.request.mode
+                    elif isinstance(msg, Assign):
                         evil.write(encode_msg(Result(
-                            msg.job_id, msg.mode, nonce=msg.lower,
+                            msg.job_id, modes[msg.job_id], nonce=msg.lower,
                             hash_value=0, found=True, searched=1,
                             chunk_id=msg.chunk_id,
                         )))
@@ -395,6 +400,64 @@ def test_forged_found_result_is_rejected_and_liar_evicted():
             digest = result.hash_value.to_bytes(32, "little")
             assert chain.hash_to_hex(digest) == chain.GENESIS_HASH_HEX
             evil_task.cancel()
+        finally:
+            await cluster.close()
+
+    run(scenario())
+
+
+def test_refused_assign_requeues_and_resends_setup():
+    """The template split's recovery seam (code-review r4): a worker
+    whose template cache lost a live job Refuses the bare Assign; the
+    coordinator requeues the chunk, re-ships the Setup, and the job
+    still completes exactly — no wedged busy-forever miner."""
+
+    async def scenario():
+        cluster = await Cluster.create(n_miners=0, chunk_size=4096)
+        from tpuminter.lsp import LspClient
+        from tpuminter.protocol import (
+            Assign, Join, Refuse, Result, Setup, decode_msg, encode_msg,
+        )
+        try:
+            w = await LspClient.connect("127.0.0.1", cluster.coord.port, FAST)
+            w.write(encode_msg(Join(backend="flaky", lanes=1)))
+            setups = []
+
+            async def act():
+                refused = False
+                templates = {}
+                while True:
+                    msg = decode_msg(await w.read())
+                    if isinstance(msg, Setup):
+                        setups.append(msg)
+                        templates[msg.request.job_id] = msg.request
+                    elif isinstance(msg, Assign):
+                        if not refused:
+                            refused = True
+                            templates.pop(msg.job_id, None)  # "evicted"
+                            w.write(encode_msg(Refuse(msg.job_id, msg.chunk_id)))
+                            continue
+                        t = templates[msg.job_id]
+                        h, n = brute_min(t.data, msg.lower, msg.upper)
+                        w.write(encode_msg(Result(
+                            msg.job_id, t.mode, n, h, found=True,
+                            searched=msg.upper - msg.lower + 1,
+                            chunk_id=msg.chunk_id,
+                        )))
+
+            task = asyncio.ensure_future(act())
+            req = Request(job_id=9, mode=PowMode.MIN, lower=0, upper=9999,
+                          data=b"refuse me")
+            result = await asyncio.wait_for(
+                submit("127.0.0.1", cluster.coord.port, req, params=FAST), 30.0
+            )
+            assert (result.hash_value, result.nonce) == brute_min(
+                b"refuse me", 0, 9999
+            )
+            assert len(setups) >= 2  # the template really was re-shipped
+            assert cluster.coord.stats["chunks_requeued"] >= 1
+            task.cancel()
+            await w.close()
         finally:
             await cluster.close()
 
@@ -501,9 +564,9 @@ def test_chaos_drops_deaths_and_concurrent_clients():
                 )
 
             jobs = [
-                asyncio.ensure_future(one_client(1, b"chaos-a", 20_000)),
-                asyncio.ensure_future(one_client(2, b"chaos-b", 15_000)),
-                asyncio.ensure_future(one_client(3, b"chaos-c", 12_000)),
+                asyncio.ensure_future(one_client(1, b"chaos-a", 200_000)),
+                asyncio.ensure_future(one_client(2, b"chaos-b", 150_000)),
+                asyncio.ensure_future(one_client(3, b"chaos-c", 120_000)),
             ]
             await asyncio.sleep(0.3)          # jobs in flight...
             # the kill must hit a LIVE cluster or this hollows out into
@@ -514,7 +577,7 @@ def test_chaos_drops_deaths_and_concurrent_clients():
             results = await asyncio.wait_for(asyncio.gather(*jobs), 90.0)
             for result, (data, upper) in zip(
                 results,
-                [(b"chaos-a", 20_000), (b"chaos-b", 15_000), (b"chaos-c", 12_000)],
+                [(b"chaos-a", 200_000), (b"chaos-b", 150_000), (b"chaos-c", 120_000)],
             ):
                 assert (result.hash_value, result.nonce) == brute_min(
                     data, 0, upper
@@ -573,17 +636,28 @@ def test_pod_worker_death_requeues_to_cpu():
         )
         await cluster.add_miner(CpuMiner(batch=256))
         try:
-            req = Request(job_id=1, mode=PowMode.MIN, lower=0, upper=9999,
+            # large enough that a warm pod can't finish before the kill
+            # lands (a 10k job completed in <0.2 s once JAX was warm and
+            # turned this into a flake)
+            req = Request(job_id=1, mode=PowMode.MIN, lower=0, upper=149_999,
                           data=b"pod dies")
             job = asyncio.ensure_future(
                 submit("127.0.0.1", cluster.coord.port, req, params=FAST)
             )
-            await asyncio.sleep(0.2)
+            # kill the pod the moment it demonstrably holds a chunk
+            for _ in range(2000):
+                stats = cluster.coord.worker_stats()
+                if any(s["backend"] == "pod" and s["busy"]
+                       for s in stats.values()):
+                    break
+                await asyncio.sleep(0.005)
+            else:
+                raise AssertionError("pod never got a chunk")
             assert not job.done(), "job finished before the kill landed"
             await cluster.kill_miner(0)  # the whole "slice" goes down
             result = await asyncio.wait_for(job, 60.0)
             assert (result.hash_value, result.nonce) == brute_min(
-                b"pod dies", 0, 9999
+                b"pod dies", 0, 149_999
             )
             # the death really cost a chunk (not an idle-miner kill)
             assert cluster.coord.stats["chunks_requeued"] >= 1

@@ -38,12 +38,15 @@ from tpuminter.lsp import LspServer, Params
 from tpuminter.lsp.params import FAST
 from tpuminter.protocol import (
     MIN_UNTRACKED,
+    Assign,
     Cancel,
     Join,
     PowMode,
     ProtocolError,
+    Refuse,
     Request,
     Result,
+    Setup,
     decode_msg,
     encode_msg,
 )
@@ -62,6 +65,12 @@ DEFAULT_CHUNK_SIZE = 16_384
 #: the requeue ping-pong a deterministically-buggy backend could otherwise
 #: sustain forever against its own rejected chunk
 MAX_REJECTIONS = 3
+
+#: CONSECUTIVE Refuse messages tolerated per miner before eviction. An
+#: honest worker refuses at most once per (job, desync) — the re-sent
+#: Setup fixes the next dispatch — so consecutive refusals this deep mean
+#: a peer that will never accept work. Reset on any accepted Result.
+MAX_REFUSALS = 8
 
 #: A miner's ``lanes`` hint is its relative throughput at *double-SHA*;
 #: scrypt is ~10^3-10^4× more work per nonce (memory-hard by design), so
@@ -86,6 +95,7 @@ class _MinerState:
     chunk: Optional[Tuple[int, int, int, int]] = None
     chunk_at: float = 0.0  # monotonic dispatch time of `chunk`
     rejections: int = 0
+    refusals: int = 0  # consecutive Refuses; reset on accepted Result
     #: per-worker observability (SURVEY.md §5): verified work only
     hashes: int = 0
     chunks_done: int = 0
@@ -121,6 +131,8 @@ class _Job:
     ranges: Deque[Tuple[int, int]] = field(default_factory=deque)
     inflight: Dict[int, Tuple[int, int]] = field(default_factory=dict)  # miner conn → range
     best: Optional[Tuple[int, int]] = None  # (hash_value, nonce) min-fold
+    #: miner conn_ids that hold this job's template (got its Setup)
+    setup_sent: set = field(default_factory=set)
     done: bool = False
     started: float = field(default_factory=time.monotonic)
     hashes_done: int = 0
@@ -224,6 +236,8 @@ class Coordinator:
                     self._on_request(conn_id, msg)
                 elif isinstance(msg, Result):
                     self._on_result(conn_id, msg)
+                elif isinstance(msg, Refuse):
+                    self._on_refuse(conn_id, msg)
                 else:
                     log.warning(
                         "conn %d: unexpected %s", conn_id, type(msg).__name__
@@ -345,6 +359,7 @@ class Coordinator:
             self.stats["hashes"] += searched
             miner.hashes += searched
             miner.chunks_done += 1
+            miner.refusals = 0  # accepted work: the peer is functional
             miner.last_result = time.monotonic()
             if self._hedge_after is not None:
                 self._settle_hedges(job, conn_id, lo, hi)
@@ -357,6 +372,47 @@ class Coordinator:
                     or job.best[0] <= (job.request.target or 0)
                 )
                 self._finish_job(job, found=found)
+        self._dispatch()
+
+    def _on_refuse(self, conn_id: int, msg: Refuse) -> None:
+        """A worker couldn't act on an Assign (its template cache lost
+        the job). Requeue the chunk and forget we Setup this worker for
+        the job — the next dispatch to it re-ships the template. See
+        ``protocol.Refuse``."""
+        miner = self._miners.get(conn_id)
+        if miner is None:
+            return
+        if miner.chunk is not None and miner.chunk[0] == msg.chunk_id:
+            _, job_id, lo, hi = miner.chunk
+            miner.chunk = None
+            job = self._jobs.get(job_id)
+            if job is not None and not job.done:
+                job.inflight.pop(conn_id, None)
+                job.setup_sent.discard(conn_id)
+                self._requeue_chunk(job, lo, hi)
+                log.info(
+                    "miner %d refused chunk %d of job %d; requeued "
+                    "[%d, %d] (template will be re-sent)",
+                    conn_id, msg.chunk_id, job_id, lo, hi,
+                )
+        miner.refusals += 1
+        if miner.refusals >= MAX_REFUSALS:
+            # mirror _on_lost: a live assignment (possible when this
+            # Refuse was stale and the miner holds a different chunk)
+            # must be requeued, or its job would wait on it forever
+            if miner.chunk is not None:
+                _, job_id, lo, hi = miner.chunk
+                miner.chunk = None
+                job = self._jobs.get(job_id)
+                if job is not None and not job.done:
+                    job.inflight.pop(conn_id, None)
+                    self._requeue_chunk(job, lo, hi)
+            log.warning(
+                "miner %d evicted after %d consecutive refusals",
+                conn_id, miner.refusals,
+            )
+            self._miners.pop(conn_id, None)
+            self._server.close_conn(conn_id)
         self._dispatch()
 
     def _requeue_chunk(self, job: _Job, lo: int, hi: int) -> None:
@@ -531,18 +587,19 @@ class Coordinator:
         miner.chunk_at = time.monotonic()
         job.inflight[miner.conn_id] = (lo, hi)
         try:
+            if miner.conn_id not in job.setup_sent:
+                # ship the job template (header/coinbase/branch/...) once
+                # per worker; every dispatch after that is a tiny Assign.
+                # LSP's ordered delivery guarantees the worker caches the
+                # Setup before any Assign referencing it arrives.
+                self._server.write(
+                    miner.conn_id,
+                    encode_msg(Setup(dc_replace(job.request, job_id=job.job_id))),
+                )
+                job.setup_sent.add(miner.conn_id)
             self._server.write(
                 miner.conn_id,
-                encode_msg(
-                    # the chunk Request is the client's Request with the
-                    # carved range + this dispatch's identity; replace()
-                    # keeps every dialect field (rolled coinbase/branch,
-                    # scrypt params, ...) intact
-                    dc_replace(
-                        job.request, job_id=job.job_id, lower=lo, upper=hi,
-                        chunk_id=chunk_id,
-                    )
-                ),
+                encode_msg(Assign(job.job_id, chunk_id, lo, hi)),
             )
         except ConnectionError:
             # lost between our bookkeeping and the write; undo
@@ -616,6 +673,10 @@ class Coordinator:
             ):
                 m.chunk = None
                 job.inflight.pop(m.conn_id, None)
+                # the job is still live and this Cancel makes the loser
+                # evict its template — forget we Setup it so a later
+                # dispatch of THIS job to it re-ships the template
+                job.setup_sent.discard(m.conn_id)
                 try:
                     self._server.write(m.conn_id, encode_msg(Cancel(job.job_id)))
                 except ConnectionError:

@@ -6,6 +6,15 @@ ends of an LSP connection run the identical machine — sliding-window send
 with per-frame retransmit backoff, in-order buffered delivery, heartbeat
 on idle epochs, and loss after ``epoch_limit`` silent epochs.
 
+App payloads of any size are accepted: each DATA frame carries one
+*fragment* — a 1-byte more-fragments flag + up to ``MAX_PAYLOAD - 1``
+bytes — and the in-order delivery guarantee makes reassembly a simple
+concatenation (fragments of one message can never interleave with
+another's because ``write`` emits them back-to-back on the event-loop
+thread). The reference caps messages at one datagram; a framework whose
+Requests carry real coinbases and merkle branches (BASELINE.json:9-10)
+cannot (a mainnet rolled job encodes to several kB).
+
 Runs entirely on the asyncio event-loop thread; no locks (the asyncio
 re-derivation of the reference's event-loop goroutine + channels).
 """
@@ -14,10 +23,20 @@ from __future__ import annotations
 
 import asyncio
 from collections import OrderedDict, deque
-from typing import Callable, Deque, Dict
+from typing import Callable, Deque, Dict, List
 
-from tpuminter.lsp.message import Frame, MsgType
+from tpuminter.lsp.message import MAX_PAYLOAD, Frame, MsgType
 from tpuminter.lsp.params import Params
+
+#: Fragment flag byte: final (or only) fragment vs more to follow.
+_FINAL, _MORE = b"\x00", b"\x01"
+#: App bytes per fragment (one byte of each frame is the flag).
+FRAGMENT_SIZE = MAX_PAYLOAD - 1
+#: Reassembly bound. Honest app messages are a few kB (the largest — a
+#: mainnet rolled job — is ~2 kB); a peer streaming more-fragments past
+#: this is buggy or hostile and gets the connection declared lost, so
+#: fragmentation cannot be used to grow our memory without bound.
+MAX_MESSAGE = 1 << 20
 
 
 class _Pending:
@@ -59,6 +78,8 @@ class ConnState:
         # receive side
         self._expected = 1
         self._ooo: Dict[int, bytes] = {}
+        self._rx_parts: List[bytes] = []  # fragments of the message in progress
+        self._rx_bytes = 0
 
         # liveness
         self._silent_epochs = 0
@@ -95,6 +116,26 @@ class ConnState:
         self._unacked[frame.seq] = _Pending(frame)
         self._send(frame)
 
+    def _on_fragment(self, data: bytes) -> None:
+        """Reassemble one in-order fragment; deliver on the final one.
+        An empty or flag-less frame can only come from a mis-speaking
+        peer — treat it like corruption (drop)."""
+        if not data:
+            return
+        self._rx_parts.append(data[1:])
+        self._rx_bytes += len(data) - 1
+        if self._rx_bytes > MAX_MESSAGE:
+            self._rx_parts.clear()
+            self._rx_bytes = 0
+            self.declare_lost(
+                f"peer exceeded the {MAX_MESSAGE}-byte reassembly bound"
+            )
+            return
+        if data[:1] == _FINAL:
+            parts, self._rx_parts = self._rx_parts, []
+            self._rx_bytes = 0
+            self._deliver(parts[0] if len(parts) == 1 else b"".join(parts))
+
     def _finish_close_if_drained(self) -> None:
         if self.closing and not self._unacked and not self._pending:
             self.closed_event.set()
@@ -106,12 +147,17 @@ class ConnState:
         return len(self._unacked)
 
     def write(self, payload: bytes) -> None:
+        """Queue an app message of any size for reliable in-order
+        delivery (fragmented across DATA frames as needed)."""
         if self.lost or self.closing:
             raise ConnectionError(f"conn {self.conn_id} is closed or lost")
-        if self._window_open():
-            self._send_data(payload)
-        else:
-            self._pending.append(payload)
+        for start in range(0, max(len(payload), 1), FRAGMENT_SIZE):
+            part = payload[start : start + FRAGMENT_SIZE]
+            flag = _MORE if start + FRAGMENT_SIZE < len(payload) else _FINAL
+            if self._window_open():
+                self._send_data(flag + part)
+            else:
+                self._pending.append(flag + part)
 
     def on_frame(self, frame: Frame) -> None:
         """Handle a decoded frame from the peer."""
@@ -124,8 +170,10 @@ class ConnState:
             self._send(Frame(MsgType.ACK, self.conn_id, frame.seq))
             if frame.seq >= self._expected and frame.seq not in self._ooo:
                 self._ooo[frame.seq] = frame.payload
-                while self._expected in self._ooo:
-                    self._deliver(self._ooo.pop(self._expected))
+                # a fragment can declare the conn lost (reassembly bound);
+                # nothing may be delivered after on_lost fires
+                while self._expected in self._ooo and not self.lost:
+                    self._on_fragment(self._ooo.pop(self._expected))
                     self._expected += 1
         elif frame.type == MsgType.ACK:
             if frame.seq == 0:
@@ -174,6 +222,9 @@ class ConnState:
         self.lost = True
         self._unacked.clear()
         self._pending.clear()
+        self._ooo.clear()
+        self._rx_parts.clear()
+        self._rx_bytes = 0
         self.closed_event.set()
         if not self.suppress_loss_event:
             self._on_lost(reason)

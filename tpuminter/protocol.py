@@ -39,6 +39,9 @@ __all__ = [
     "Request",
     "Result",
     "Cancel",
+    "Setup",
+    "Assign",
+    "Refuse",
     "Message",
     "encode_msg",
     "decode_msg",
@@ -180,6 +183,48 @@ class Result:
 
 
 @dataclass(frozen=True)
+class Setup:
+    """Coordinator → worker: cache this job's template.
+
+    Sent once per (worker, job) before the first :class:`Assign`, so the
+    per-dispatch message stays tiny no matter how large the job payload
+    is (a mainnet rolled job's coinbase + 12-deep branch is ~1.5 kB —
+    re-shipping it on every chunk dispatch would dominate control-plane
+    bytes). ``request`` is the client's full-range Request re-stamped
+    with the coordinator's internal job id; its ``lower``/``upper`` are
+    the whole job's range and are superseded per chunk by Assign.
+    """
+
+    request: Request
+
+
+@dataclass(frozen=True)
+class Assign:
+    """Coordinator → worker: mine ``[lower, upper]`` of the job whose
+    template a prior :class:`Setup` delivered. LSP's in-order delivery
+    guarantees the Setup precedes every Assign referencing it."""
+
+    job_id: int
+    chunk_id: int
+    lower: int
+    upper: int
+
+
+@dataclass(frozen=True)
+class Refuse:
+    """Worker → coordinator: I cannot mine this dispatch (no cached
+    template for its job). The recovery seam that keeps the template
+    split self-healing: the coordinator requeues the chunk, forgets it
+    ever Setup this worker for the job, and the next dispatch re-ships
+    the template. Without it, any cache/`setup_sent` divergence (however
+    caused) would wedge the worker busy-forever on a silently-dropped
+    Assign."""
+
+    job_id: int
+    chunk_id: int
+
+
+@dataclass(frozen=True)
 class Cancel:
     """Coordinator → worker: stop mining ``job_id``, its answer is in.
 
@@ -193,9 +238,61 @@ class Cancel:
     job_id: int
 
 
-Message = Union[Join, Request, Result, Cancel]
+Message = Union[Join, Request, Result, Cancel, Setup, Assign, Refuse]
 
-_KINDS = {"join": Join, "request": Request, "result": Result, "cancel": Cancel}
+_KINDS = {
+    "join": Join,
+    "request": Request,
+    "result": Result,
+    "cancel": Cancel,
+    "setup": Setup,
+    "assign": Assign,
+    "refuse": Refuse,
+}
+
+
+def _request_obj(msg: Request) -> dict:
+    obj = {
+        "kind": "request",
+        "job_id": msg.job_id,
+        "mode": msg.mode.value,
+        "lower": msg.lower,
+        "upper": msg.upper,
+        "chunk_id": msg.chunk_id,
+    }
+    if msg.data:
+        obj["data"] = msg.data.hex()
+    if msg.header is not None:
+        obj["header"] = msg.header.hex()
+    if msg.target is not None:
+        obj["target"] = f"{msg.target:x}"
+    if msg.rolled:
+        obj["cb_prefix"] = msg.coinbase_prefix.hex()
+        obj["cb_suffix"] = msg.coinbase_suffix.hex()
+        obj["en_size"] = msg.extranonce_size
+        obj["branch"] = [sib.hex() for sib in msg.branch]
+        obj["nonce_bits"] = msg.nonce_bits
+    return obj
+
+
+def _request_from_obj(obj: dict) -> Request:
+    return Request(
+        job_id=int(obj["job_id"]),
+        mode=PowMode(obj["mode"]),
+        lower=int(obj["lower"]),
+        upper=int(obj["upper"]),
+        data=bytes.fromhex(obj["data"]) if "data" in obj else b"",
+        header=bytes.fromhex(obj["header"]) if "header" in obj else None,
+        target=int(obj["target"], 16) if "target" in obj else None,
+        chunk_id=int(obj.get("chunk_id", 0)),
+        coinbase_prefix=(
+            bytes.fromhex(obj["cb_prefix"]) if "cb_prefix" in obj else None
+        ),
+        coinbase_suffix=bytes.fromhex(obj.get("cb_suffix", "")),
+        extranonce_size=int(obj.get("en_size", 4)),
+        branch=tuple(bytes.fromhex(s) for s in obj.get("branch", [])),
+        nonce_bits=int(obj.get("nonce_bits", 32)),
+    )
 
 
 def encode_msg(msg: Message) -> bytes:
@@ -203,26 +300,19 @@ def encode_msg(msg: Message) -> bytes:
     if isinstance(msg, Join):
         obj = {"kind": "join", "backend": msg.backend, "lanes": msg.lanes}
     elif isinstance(msg, Request):
+        obj = _request_obj(msg)
+    elif isinstance(msg, Setup):
+        obj = {"kind": "setup", "request": _request_obj(msg.request)}
+    elif isinstance(msg, Assign):
         obj = {
-            "kind": "request",
+            "kind": "assign",
             "job_id": msg.job_id,
-            "mode": msg.mode.value,
+            "chunk_id": msg.chunk_id,
             "lower": msg.lower,
             "upper": msg.upper,
-            "chunk_id": msg.chunk_id,
         }
-        if msg.data:
-            obj["data"] = msg.data.hex()
-        if msg.header is not None:
-            obj["header"] = msg.header.hex()
-        if msg.target is not None:
-            obj["target"] = f"{msg.target:x}"
-        if msg.rolled:
-            obj["cb_prefix"] = msg.coinbase_prefix.hex()
-            obj["cb_suffix"] = msg.coinbase_suffix.hex()
-            obj["en_size"] = msg.extranonce_size
-            obj["branch"] = [sib.hex() for sib in msg.branch]
-            obj["nonce_bits"] = msg.nonce_bits
+    elif isinstance(msg, Refuse):
+        obj = {"kind": "refuse", "job_id": msg.job_id, "chunk_id": msg.chunk_id}
     elif isinstance(msg, Result):
         obj = {
             "kind": "result",
@@ -254,23 +344,21 @@ def decode_msg(raw: bytes) -> Message:
         if kind == "join":
             return Join(backend=str(obj.get("backend", "cpu")), lanes=int(obj.get("lanes", 1)))
         if kind == "request":
-            return Request(
+            return _request_from_obj(obj)
+        if kind == "setup":
+            req = obj["request"]
+            if not isinstance(req, dict):
+                raise ProtocolError("setup message needs a request object")
+            return Setup(request=_request_from_obj(req))
+        if kind == "assign":
+            return Assign(
                 job_id=int(obj["job_id"]),
-                mode=PowMode(obj["mode"]),
+                chunk_id=int(obj["chunk_id"]),
                 lower=int(obj["lower"]),
                 upper=int(obj["upper"]),
-                data=bytes.fromhex(obj["data"]) if "data" in obj else b"",
-                header=bytes.fromhex(obj["header"]) if "header" in obj else None,
-                target=int(obj["target"], 16) if "target" in obj else None,
-                chunk_id=int(obj.get("chunk_id", 0)),
-                coinbase_prefix=(
-                    bytes.fromhex(obj["cb_prefix"]) if "cb_prefix" in obj else None
-                ),
-                coinbase_suffix=bytes.fromhex(obj.get("cb_suffix", "")),
-                extranonce_size=int(obj.get("en_size", 4)),
-                branch=tuple(bytes.fromhex(s) for s in obj.get("branch", [])),
-                nonce_bits=int(obj.get("nonce_bits", 32)),
             )
+        if kind == "refuse":
+            return Refuse(job_id=int(obj["job_id"]), chunk_id=int(obj["chunk_id"]))
         if kind == "result":
             return Result(
                 job_id=int(obj["job_id"]),

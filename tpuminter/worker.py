@@ -30,14 +30,19 @@ from typing import Callable, Iterator, Optional
 from tpuminter import chain
 from tpuminter.lsp import LspClient, LspConnectionLost, Params
 from tpuminter.lsp.params import FAST
+from dataclasses import replace as dc_replace
+
 from tpuminter.protocol import (
+    Assign,
     Cancel,
     Join,
     Message,
     PowMode,
     ProtocolError,
+    Refuse,
     Request,
     Result,
+    Setup,
     decode_msg,
     encode_msg,
 )
@@ -280,6 +285,12 @@ async def run_miner(
     client.write(encode_msg(Join(backend=miner.backend, lanes=miner.lanes)))
     pending: "asyncio.Queue[Message]" = asyncio.Queue()
     read_task: Optional[asyncio.Task] = None
+    #: job_id → template Request from a Setup (insertion-ordered so the
+    #: cap evicts oldest-first; Cancel evicts eagerly, the cap only mops
+    #: up after jobs that finished without one reaching this worker). If
+    #: eviction ever races a live job, the Refuse seam below heals it.
+    templates: dict = {}
+    _TEMPLATE_CAP = 256
     try:
         while True:
             # -- next message: drained backlog first, then the wire ------
@@ -294,7 +305,29 @@ async def run_miner(
                 if msg is None:
                     continue
             if isinstance(msg, Cancel):
+                templates.pop(msg.job_id, None)
                 continue  # for a job we are not mining: stale, drop
+            if isinstance(msg, Setup):
+                templates[msg.request.job_id] = msg.request
+                while len(templates) > _TEMPLATE_CAP:
+                    templates.pop(next(iter(templates)))
+                continue
+            if isinstance(msg, Assign):
+                tmpl = templates.get(msg.job_id)
+                if tmpl is None:
+                    # template missing (evicted by a hedge-loser Cancel or
+                    # the cap): tell the coordinator so it requeues the
+                    # chunk and re-ships the Setup — silently dropping
+                    # would leave us marked busy-forever on its books
+                    log.warning(
+                        "worker: no template for job %d; refusing chunk %d",
+                        msg.job_id, msg.chunk_id,
+                    )
+                    client.write(encode_msg(Refuse(msg.job_id, msg.chunk_id)))
+                    continue
+                msg = dc_replace(
+                    tmpl, lower=msg.lower, upper=msg.upper, chunk_id=msg.chunk_id
+                )
             if not isinstance(msg, Request):
                 log.warning("worker: unexpected %s, dropping", type(msg).__name__)
                 continue
