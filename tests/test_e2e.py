@@ -464,6 +464,77 @@ def test_refused_assign_requeues_and_resends_setup():
     run(scenario())
 
 
+def test_under_search_audit_catches_lazy_worker(monkeypatch):
+    """VERDICT r3 missing #4: a worker whose Results verify (real hash
+    of a real nonce) but that never actually searches its ranges is
+    caught by a sampled re-mine on another worker, evicted, and its
+    chunks re-mined — the client still gets the exact answer."""
+    from tpuminter import coordinator as coord_mod
+
+    # full-chunk audits make conviction deterministic; the fixture
+    # guarantees no chunk's argmin sits at its own lower bound (what the
+    # lazy worker always claims)
+    monkeypatch.setattr(coord_mod, "AUDIT_SAMPLE", 1024)
+    data = b"audit me"
+    for lo in range(0, 8192, 1024):
+        assert brute_min(data, lo, lo + 1023)[1] != lo, lo
+
+    async def scenario():
+        cluster = await Cluster.create(
+            n_miners=0, chunk_size=1024, audit_rate=1.0, audit_seed=5,
+        )
+        from tpuminter.lsp import LspClient, LspConnectionLost
+        from tpuminter.protocol import (
+            Assign, Join, Result, Setup, decode_msg, encode_msg,
+        )
+        try:
+            lazy = await LspClient.connect("127.0.0.1", cluster.coord.port, FAST)
+            lazy.write(encode_msg(Join(backend="lazy", lanes=1)))
+
+            async def be_lazy():
+                # instantly answer every dispatch with the (verifiable!)
+                # hash of the range's first nonce — never searching
+                modes = {}
+                try:
+                    while True:
+                        msg = decode_msg(await lazy.read())
+                        if isinstance(msg, Setup):
+                            modes[msg.request.job_id] = msg.request
+                        elif isinstance(msg, Assign):
+                            req = modes[msg.job_id]
+                            lazy.write(encode_msg(Result(
+                                msg.job_id, req.mode, nonce=msg.lower,
+                                hash_value=chain.toy_hash(req.data, msg.lower),
+                                found=True,
+                                searched=msg.upper - msg.lower + 1,
+                                chunk_id=msg.chunk_id,
+                            )))
+                except LspConnectionLost:
+                    pass  # evicted, as expected
+
+            lazy_task = asyncio.ensure_future(be_lazy())
+            await asyncio.sleep(0.05)
+            await cluster.add_miner(CpuMiner(batch=256))
+
+            req = Request(job_id=3, mode=PowMode.MIN, lower=0, upper=8191,
+                          data=data)
+            result = await asyncio.wait_for(
+                submit("127.0.0.1", cluster.coord.port, req, params=FAST), 30.0
+            )
+            # exact answer despite the lazy worker's garbage folds
+            assert (result.hash_value, result.nonce) == brute_min(data, 0, 8191)
+            assert cluster.coord.stats["audits_failed"] >= 1
+            assert cluster.coord.stats["audits_done"] >= 1
+            # the lazy worker is gone from the fleet
+            stats = cluster.coord.worker_stats()
+            assert all(s["backend"] != "lazy" for s in stats.values())
+            lazy_task.cancel()
+        finally:
+            await cluster.close()
+
+    run(scenario())
+
+
 def test_cancelled_miners_are_redispatched():
     """Regression: a Cancel that lands mid-chunk must return the miner to
     the idle pool (a cancelled worker sends no Result, so nothing else
@@ -540,11 +611,11 @@ def test_worker_stats_after_job():
 
 def test_chaos_drops_deaths_and_concurrent_clients():
     """Robustness under combined failure modes (SURVEY.md §4's
-    drops+epochs long-running tests): 10% packet loss in BOTH
-    directions at the coordinator's transport seam, a miner hard-killed
-    mid-flight, a replacement joining mid-flight — three concurrent
-    clients must all still get exact answers, with every retransmission
-    and requeue happening under loss."""
+    drops+epochs long-running tests): 10% loss + 10% duplication + 10%
+    reordering in BOTH directions at the coordinator's transport seam,
+    a miner hard-killed mid-flight, a replacement joining mid-flight —
+    three concurrent clients must all still get exact answers, with
+    every retransmission and requeue happening under the storm."""
 
     async def scenario():
         cluster = await Cluster.create(
@@ -553,8 +624,8 @@ def test_chaos_drops_deaths_and_concurrent_clients():
         )
         try:
             endpoint = cluster.coord._server.endpoint
-            endpoint.set_read_drop_rate(0.10)
-            endpoint.set_write_drop_rate(0.10)
+            endpoint.set_fault_rates(drop=0.10, dup=0.10, reorder=0.10)
+            endpoint.reorder_delay = 0.02
 
             async def one_client(jid, data, upper):
                 req = Request(job_id=jid, mode=PowMode.MIN, lower=0,

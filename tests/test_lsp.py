@@ -129,6 +129,40 @@ def test_large_payloads_fragment_and_reassemble():
     run(scenario(), timeout=60.0)
 
 
+def test_drop_dup_reorder_storm_delivers_exactly_once_in_order():
+    """In-order exactly-once delivery survives 10% drop + 10% dup + 10%
+    reorder simultaneously in both directions (VERDICT r3 missing #3:
+    real UDP duplicates and reorders, not just drops). A multi-fragment
+    payload rides along so reassembly is stressed under the same storm."""
+
+    async def scenario():
+        server = await LspServer.create(params=FAST, seed=5)
+        client = await LspClient.connect("127.0.0.1", server.port, FAST, seed=6)
+        for ep in (server.endpoint, client.endpoint):
+            ep.set_fault_rates(drop=0.1, dup=0.1, reorder=0.1)
+            ep.reorder_delay = 0.02
+        n = 60
+        payloads = [i.to_bytes(4, "big") for i in range(n)] + [b"frag" * 1000]
+        for p in payloads:
+            client.write(p)
+        conn_id = None
+        for want in payloads:
+            conn_id, payload = await server.read()
+            assert payload == want
+        for p in payloads:
+            server.write(conn_id, p)
+        for want in payloads:
+            assert await client.read() == want
+        eps = (server.endpoint, client.endpoint)
+        assert sum(e.dropped_out + e.dropped_in for e in eps) > 0
+        assert sum(e.duplicated_out + e.duplicated_in for e in eps) > 0
+        assert sum(e.reordered_out + e.reordered_in for e in eps) > 0
+        await client.close()
+        await server.close()
+
+    run(scenario(), timeout=60.0)
+
+
 def test_reassembly_overflow_declares_connection_lost():
     """A peer streaming more-fragments forever must not grow our memory
     without bound (code-review r4): past MAX_MESSAGE the connection is

@@ -83,8 +83,14 @@ def main(argv: Optional[list] = None) -> None:
     host, _, port = args.hostport.rpartition(":")
     logging.basicConfig(level=logging.WARNING)
 
+    def _hex(value: str, what: str) -> bytes:
+        try:
+            return bytes.fromhex(value)
+        except ValueError:
+            parser.error(f"{what} is not valid hex: {value!r}")
+
     if args.header is not None:
-        header = bytes.fromhex(args.header)
+        header = _hex(args.header, "--header")
         rolled = {}
         upper = args.max_nonce_opt
         if args.coinbase_prefix is not None:
@@ -111,10 +117,10 @@ def main(argv: Optional[list] = None) -> None:
                     )
             upper = (max_en << 32) | 0xFFFFFFFF
             rolled = dict(
-                coinbase_prefix=bytes.fromhex(args.coinbase_prefix),
-                coinbase_suffix=bytes.fromhex(args.coinbase_suffix),
+                coinbase_prefix=_hex(args.coinbase_prefix, "--coinbase-prefix"),
+                coinbase_suffix=_hex(args.coinbase_suffix, "--coinbase-suffix"),
                 extranonce_size=args.extranonce_size,
-                branch=tuple(bytes.fromhex(s) for s in args.branch),
+                branch=tuple(_hex(s, "--branch") for s in args.branch),
             )
         request = Request(
             job_id=1,

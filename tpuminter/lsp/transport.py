@@ -17,12 +17,19 @@ DatagramHandler = Callable[[bytes, Addr], Union[None, Awaitable[None]]]
 
 
 class UdpEndpoint(asyncio.DatagramProtocol):
-    """A UDP socket with injectable packet loss.
+    """A UDP socket with injectable packet loss, duplication, and
+    reordering — everything a real UDP path does to you.
 
-    ``write_drop_rate`` / ``read_drop_rate`` ∈ [0, 1] drop outgoing /
-    incoming datagrams using a seeded PRNG, so loss patterns are
-    reproducible in CI (≙ ``lspnet.SetWriteDropPercent`` /
-    ``SetReadDropPercent``).
+    All rates are ∈ [0, 1] and drawn from one seeded PRNG, so fault
+    patterns are reproducible in CI (≙ ``lspnet.SetWriteDropPercent`` /
+    ``SetReadDropPercent``; dup/reorder have no reference analogue but
+    SURVEY.md §4's "own the transport seam, inject faults at it" is only
+    honest if the seam can produce every UDP failure mode):
+
+    - ``write_drop_rate`` / ``read_drop_rate`` — drop the datagram.
+    - ``write_dup_rate`` / ``read_dup_rate`` — deliver it twice.
+    - ``write_reorder_rate`` / ``read_reorder_rate`` — hold it back
+      ``reorder_delay`` seconds so later datagrams overtake it.
     """
 
     def __init__(self, on_datagram: DatagramHandler, seed: Optional[int] = None):
@@ -30,6 +37,11 @@ class UdpEndpoint(asyncio.DatagramProtocol):
         self._rng = random.Random(seed)
         self.write_drop_rate = 0.0
         self.read_drop_rate = 0.0
+        self.write_dup_rate = 0.0
+        self.read_dup_rate = 0.0
+        self.write_reorder_rate = 0.0
+        self.read_reorder_rate = 0.0
+        self.reorder_delay = 0.05
         self._transport: Optional[asyncio.DatagramTransport] = None
         self._closed = asyncio.get_running_loop().create_future()
         #: Counters for tests/metrics.
@@ -37,6 +49,10 @@ class UdpEndpoint(asyncio.DatagramProtocol):
         self.received = 0
         self.dropped_out = 0
         self.dropped_in = 0
+        self.duplicated_out = 0
+        self.duplicated_in = 0
+        self.reordered_out = 0
+        self.reordered_in = 0
 
     @classmethod
     async def create(
@@ -61,6 +77,25 @@ class UdpEndpoint(asyncio.DatagramProtocol):
         if self.read_drop_rate > 0 and self._rng.random() < self.read_drop_rate:
             self.dropped_in += 1
             return
+        copies = 1
+        if self.read_dup_rate > 0 and self._rng.random() < self.read_dup_rate:
+            self.duplicated_in += 1
+            copies = 2
+        for _ in range(copies):
+            if (
+                self.read_reorder_rate > 0
+                and self._rng.random() < self.read_reorder_rate
+            ):
+                self.reordered_in += 1
+                asyncio.get_running_loop().call_later(
+                    self.reorder_delay, self._deliver, data, addr
+                )
+            else:
+                self._deliver(data, addr)
+
+    def _deliver(self, data: bytes, addr: Addr) -> None:
+        if self._transport is None or self._transport.is_closing():
+            return  # a held-back (reordered) datagram outlived the socket
         self.received += 1
         result = self._on_datagram(data, addr)
         if asyncio.iscoroutine(result):
@@ -78,12 +113,31 @@ class UdpEndpoint(asyncio.DatagramProtocol):
         return self._transport.get_extra_info("sockname")[:2]
 
     def send(self, data: bytes, addr: Addr) -> None:
-        """Send one datagram (silently dropped at ``write_drop_rate``)."""
+        """Send one datagram (subject to the injected write faults)."""
         if self._transport is None or self._transport.is_closing():
             return
         if self.write_drop_rate > 0 and self._rng.random() < self.write_drop_rate:
             self.dropped_out += 1
             return
+        copies = 1
+        if self.write_dup_rate > 0 and self._rng.random() < self.write_dup_rate:
+            self.duplicated_out += 1
+            copies = 2
+        for _ in range(copies):
+            if (
+                self.write_reorder_rate > 0
+                and self._rng.random() < self.write_reorder_rate
+            ):
+                self.reordered_out += 1
+                asyncio.get_running_loop().call_later(
+                    self.reorder_delay, self._send_now, data, addr
+                )
+            else:
+                self._send_now(data, addr)
+
+    def _send_now(self, data: bytes, addr: Addr) -> None:
+        if self._transport is None or self._transport.is_closing():
+            return  # a held-back (reordered) datagram outlived the socket
         self.sent += 1
         self._transport.sendto(data, addr)
 
@@ -92,6 +146,21 @@ class UdpEndpoint(asyncio.DatagramProtocol):
 
     def set_read_drop_rate(self, rate: float) -> None:
         self.read_drop_rate = rate
+
+    def set_fault_rates(
+        self,
+        *,
+        drop: Optional[float] = None,
+        dup: Optional[float] = None,
+        reorder: Optional[float] = None,
+    ) -> None:
+        """Set any fault class symmetrically in both directions."""
+        if drop is not None:
+            self.write_drop_rate = self.read_drop_rate = drop
+        if dup is not None:
+            self.write_dup_rate = self.read_dup_rate = dup
+        if reorder is not None:
+            self.write_reorder_rate = self.read_reorder_rate = reorder
 
     def close(self) -> None:
         if self._transport is not None and not self._transport.is_closing():
