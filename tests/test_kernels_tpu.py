@@ -163,6 +163,30 @@ req5 = Request(job_id=5, mode=PowMode.TARGET, lower=0, upper=(4 << 10) - 1,
 r5 = drain(TpuMiner(slab=1 << 16).mine(req5))
 assert r5.found and r5.nonce == 2698 and r5.hash_value == H_MIN
 print("ROLL-TRACK-OK")
+
+# --- pod paths on the real chip (1-chip mesh): the shard_map'd Pallas
+# MIN sweep (full span + ragged single-chip tail) and the exact-min
+# TARGET sweep, both bit-exact vs host brute force
+from tpuminter.parallel import make_mesh
+from tpuminter.pod_worker import PodMiner
+pm = PodMiner(mesh=make_mesh(jax.devices()[:1]), slab_per_device=1 << 12,
+              n_slabs=2, kernel="pallas")
+req6 = Request(job_id=6, mode=PowMode.MIN, lower=10, upper=(1 << 12) + 500,
+               data=b"pod min tpu")
+r6 = drain(pm.mine(req6))
+want6 = min((chain.toy_hash(b"pod min tpu", i), i)
+            for i in range(10, (1 << 12) + 501))
+assert (r6.hash_value, r6.nonce) == want6
+print("POD-MIN-OK")
+
+pe = PodMiner(mesh=make_mesh(jax.devices()[:1]), slab_per_device=256,
+              n_slabs=2, kernel="pallas", exact_min=True)
+req7 = Request(job_id=7, mode=PowMode.TARGET, lower=0, upper=999,
+               header=chain.GENESIS_HEADER.pack(),
+               target=chain.bits_to_target(0x1D00FFFF))
+r7 = drain(pe.mine(req7))
+assert not r7.found and (r7.hash_value, r7.nonce) == want2
+print("POD-EXACT-OK")
 print("ALL-TPU-KERNEL-TESTS-PASSED")
 """
 

@@ -13,6 +13,7 @@ Joining the coordinator like any other miner.
 from tpuminter.parallel.mesh import (
     build_candidate_sweep,
     build_min_fold,
+    build_min_sweep_pallas,
     build_scrypt_sweep,
     build_target_sweep,
     make_mesh,
@@ -22,6 +23,7 @@ __all__ = [
     "make_mesh",
     "build_target_sweep",
     "build_min_fold",
+    "build_min_sweep_pallas",
     "build_candidate_sweep",
     "build_scrypt_sweep",
 ]

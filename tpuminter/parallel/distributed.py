@@ -1,0 +1,99 @@
+"""Multi-process (multi-host) mesh support (SURVEY.md §5 comm-backend
+row; VERDICT r3 missing #2).
+
+Two pieces:
+
+- **Bootstrap**: :func:`init_from_env` wires this process into a
+  ``jax.distributed`` cluster. On real multi-host TPU slices
+  ``jax.distributed.initialize()`` auto-detects the topology; on CPU
+  (CI) the ``TPUMINTER_COORD_ADDR`` / ``TPUMINTER_NUM_PROCS`` /
+  ``TPUMINTER_PROC_ID`` env triple pins the rendezvous explicitly and
+  collectives run over Gloo. After init, ``jax.devices()`` is the
+  GLOBAL device list, so ``parallel.make_mesh()`` builds a mesh spanning
+  every host and the ``shard_map`` sweeps' or-reduce/argmin collectives
+  ride ICI within a slice and DCN across — inserted by XLA from the
+  same programs CI runs on the virtual mesh.
+
+- **Leader→follower channel**: multi-process JAX is SPMD — every
+  process must issue the same device programs in the same order. The
+  worker role is asymmetric (only one process talks to the mining
+  coordinator), so the leader (process 0) mirrors its request stream
+  and per-step liveness to followers with the tiny broadcasts below,
+  and followers replay the identical (deterministic) ``Miner``
+  generator. See ``pod_worker.follower_loop``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+ENV_ADDR = "TPUMINTER_COORD_ADDR"
+ENV_NPROCS = "TPUMINTER_NUM_PROCS"
+ENV_PID = "TPUMINTER_PROC_ID"
+
+__all__ = [
+    "init_from_env",
+    "is_leader",
+    "broadcast_flag",
+    "broadcast_bytes",
+    "ENV_ADDR",
+    "ENV_NPROCS",
+    "ENV_PID",
+]
+
+
+def init_from_env() -> bool:
+    """Join the ``jax.distributed`` cluster the environment describes.
+
+    Returns True iff this process is part of a multi-process mesh.
+    Explicit ``TPUMINTER_*`` rendezvous wins; otherwise real multi-host
+    TPU backends are left to ``jax.distributed``'s auto-detection (a
+    no-op single process on CPU/CI).
+    """
+    import jax
+
+    addr = os.environ.get(ENV_ADDR)
+    if addr is not None:
+        jax.distributed.initialize(
+            addr,
+            num_processes=int(os.environ[ENV_NPROCS]),
+            process_id=int(os.environ[ENV_PID]),
+        )
+    return jax.process_count() > 1
+
+
+def is_leader() -> bool:
+    import jax
+
+    return jax.process_index() == 0
+
+
+def broadcast_flag(value: Optional[int] = None) -> int:
+    """Broadcast one small int from the leader (followers pass None)."""
+    from jax.experimental import multihost_utils as mhu
+
+    v = np.int32(value if value is not None else 0)
+    return int(mhu.broadcast_one_to_all(v))
+
+
+def broadcast_bytes(data: Optional[bytes] = None) -> bytes:
+    """Broadcast a byte string from the leader (followers pass None).
+
+    Length travels first so every process agrees on the (power-of-two
+    padded, to bound the jit cache) payload shape before the payload
+    collective runs.
+    """
+    from jax.experimental import multihost_utils as mhu
+
+    n = broadcast_flag(len(data) if data is not None else 0)
+    if n == 0:
+        return b""
+    size = 1 << (n - 1).bit_length()
+    buf = np.zeros(size, dtype=np.uint8)
+    if data is not None:
+        buf[:n] = np.frombuffer(data, dtype=np.uint8)
+    out = np.asarray(mhu.broadcast_one_to_all(buf))
+    return out[:n].tobytes()

@@ -260,10 +260,15 @@ class ProfiledMiner(Miner):
         """Flush a still-open trace at worker shutdown (``run_miner``'s
         finally): heartbeats no longer matter then, so serializing on
         the caller's thread is fine. Covers the Cancel-then-exit path
-        where no further ``mine()`` call would ever close it."""
+        where no further ``mine()`` call would ever close it. Delegates
+        to the wrapped miner's own close (a multi-host PodMiner must
+        still release its followers)."""
         if self._tracing:
             log.info("flushing open trace at shutdown")
             self._stop_trace()
+        closer = getattr(self._inner, "close", None)
+        if callable(closer):
+            closer()
 
 
 async def run_miner(
@@ -391,6 +396,7 @@ def _build_miner(
     exact_min: bool = False,
     slab: Optional[int] = None,
     depth: Optional[int] = None,
+    spmd_leader: bool = False,
 ) -> Miner:
     """Backend registry for the CLI; device backends import lazily.
 
@@ -416,7 +422,7 @@ def _build_miner(
     if backend == "pod":
         from tpuminter.pod_worker import PodMiner
 
-        kwargs = {}
+        kwargs = {"exact_min": exact_min, "spmd_leader": spmd_leader}
         if slab is not None:
             kwargs["slab_per_device"] = slab
         if depth is not None:
@@ -445,7 +451,7 @@ def main(argv: Optional[list] = None) -> None:
     )
     parser.add_argument(
         "--exact-min", action="store_true",
-        help="tpu backend: track the exact exhausted-range minimum "
+        help="tpu/pod backends: track the exact exhausted-range minimum "
         "(CpuMiner-compatible) at reduced throughput",
     )
     parser.add_argument(
@@ -464,8 +470,27 @@ def main(argv: Optional[list] = None) -> None:
     args = parser.parse_args(argv)
     host, _, port = args.hostport.rpartition(":")
     logging.basicConfig(level=logging.INFO)
+    spmd_leader = False
+    if args.backend == "pod":
+        # multi-host pod: every host runs this CLI; TPUMINTER_COORD_ADDR
+        # (or a real multi-host TPU runtime) wires them into one
+        # jax.distributed cluster. Only process 0 speaks the control
+        # plane; the rest replay its device programs (SPMD).
+        from tpuminter.parallel import distributed as dist
+
+        if dist.init_from_env():
+            if not dist.is_leader():
+                from tpuminter.pod_worker import follower_loop
+
+                follower_loop(_build_miner(
+                    args.backend, exact_min=args.exact_min, slab=args.slab,
+                    depth=args.depth,
+                ))
+                return
+            spmd_leader = True
     miner = _build_miner(
-        args.backend, exact_min=args.exact_min, slab=args.slab, depth=args.depth
+        args.backend, exact_min=args.exact_min, slab=args.slab,
+        depth=args.depth, spmd_leader=spmd_leader,
     )
     if args.profile:
         try:
