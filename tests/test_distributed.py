@@ -8,54 +8,18 @@ must be initialized before any other JAX use, and the test process's
 JAX is already pinned to the single-process 8-device mesh.
 """
 
-import os
-import socket
-import subprocess
-import sys
 import textwrap
 
 import pytest
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def _env(local_devices: int) -> dict:
-    import __graft_entry__ as graft
-
-    return graft.virtual_cpu_env(local_devices)
-
 
 def _run_pair(script: str, timeout: float = 420.0):
-    """Run `script` in 2 processes (TPUMINTER_* rendezvous env set),
-    return [(rc, out, err), ...]."""
-    port = _free_port()
-    procs = []
-    for pid in range(2):
-        env = _env(local_devices=4)
-        env["TPUMINTER_COORD_ADDR"] = f"127.0.0.1:{port}"
-        env["TPUMINTER_NUM_PROCS"] = "2"
-        env["TPUMINTER_PROC_ID"] = str(pid)
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", script],
-            env=env, cwd=REPO,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        ))
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        outs.append((p.returncode, out, err))
-    return outs
+    """Run `script` in 2 rendezvoused processes via the shared launcher."""
+    import __graft_entry__ as graft
+
+    return graft.run_rendezvoused(
+        script, n_procs=2, local_devices=4, timeout=timeout
+    )
 
 
 def test_multiprocess_dryrun_crosses_process_boundary():

@@ -367,8 +367,14 @@ class Coordinator:
         async def handle(reader: asyncio.StreamReader,
                          writer: asyncio.StreamWriter) -> None:
             try:
-                try:  # consume the request line, tolerate raw TCP pokes
-                    await asyncio.wait_for(reader.readline(), 0.5)
+                try:
+                    # drain the request through the blank line (closing
+                    # with unread bytes in flight can RST the response
+                    # away); tolerate raw TCP pokes and bound the drain
+                    for _ in range(100):
+                        line = await asyncio.wait_for(reader.readline(), 0.5)
+                        if not line or line in (b"\r\n", b"\n"):
+                            break
                 except asyncio.TimeoutError:
                     pass
                 body = json.dumps(self.stats_snapshot()).encode()

@@ -153,6 +153,8 @@ class PodMiner(Miner):
         self._exact_template = None
         self._min_sweep = None
         self._min_template = None
+        self._fold = None
+        self._fold_template = None
         self._template = None
         self._jax_delegate = None
 
@@ -223,9 +225,12 @@ class PodMiner(Miner):
                     return
                 yield item
         except GeneratorExit:
-            if self._open_inner is inner:
-                self._open_inner = None
-                dist.broadcast_flag(0)
+            # do NOT broadcast here: abandonment fires at GC time, often
+            # on the event-loop thread, and a blocking cross-process
+            # collective there starves LSP heartbeats (the ProfiledMiner
+            # hazard). Leave _open_inner set — the release flag goes out
+            # on the executor thread at the next mine()
+            # (_spmd_sync_abandoned) or at close().
             inner.close()
             raise
 
@@ -479,9 +484,12 @@ class PodMiner(Miner):
         """CPU-mesh/CI MIN path: jnp fold with dynamic limit masking."""
         template = ops.toy_template(req.data)
         batch_per_device = min(self.slab_per_device, 1 << 16)
-        fold = build_min_fold(
-            self.mesh, template, batch_per_device=batch_per_device
-        )
+        if self._fold is None or template != self._fold_template:
+            self._fold_template = template
+            self._fold = build_min_fold(
+                self.mesh, template, batch_per_device=batch_per_device
+            )
+        fold = self._fold
         span = self.n_dev * batch_per_device
         lim_hi = jnp.uint32(req.upper >> 32)
         lim_lo = jnp.uint32(req.upper & 0xFFFFFFFF)
