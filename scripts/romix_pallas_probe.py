@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""The measured rejection of a fused Pallas ROMix (VERDICT r3 next #4).
+
+scryptROMix phase 2 needs, per step, for every batch lane b, the
+128-byte row ``V[j_b]`` at a data-dependent index. Inside a Pallas TPU
+kernel that can only be a per-lane scalar-issued DMA (Mosaic has no
+vectorized cross-lane HBM gather), and Mosaic's 128-element minor-slice
+alignment forces rows padded to 512 bytes (or packed tiles + in-VMEM
+dynamic selects). This probe measures exactly that primitive: a
+pipelined ring of row DMAs (NSEM outstanding), rep-scaled inside the
+kernel so the ~100 ms tunnel RTT cancels out of the slope.
+
+Measured on the v5e (2026-07-30, reps 32 vs 256 at B=8192):
+**38.7 ns per row** (13.2 GB/s on the padded 512-byte rows). The
+shipping jnp path's XLA row gather moves the same logical rows at
+~5.5 ns each (23 GB/s on unpadded 128-byte rows, PERF.md), and the
+WHOLE shipping ROMix step — gather + unpack + BlockMix + pack — costs
+~28 ns/row. The fused kernel's gather alone is 1.4× the entire current
+step with zero compute attached, so the design is rejected on
+measurement, not estimate. The ~2× hoped for in PERF.md's sketch would
+have required ~10 ns/row scalar DMA issue; the hardware does 4× worse.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NSEM = 8  # DMA ring depth
+
+
+def build_gather_kernel(rows: int, batch: int, reps: int):
+    """One call = ``reps`` passes of ``batch`` random-row DMAs."""
+
+    def kernel(idx_ref, vflat_ref, out_ref, scratch, sems):
+        def pass_body(r, acc):
+            def issue(b, _):
+                row = (idx_ref[b] + r * 977) % rows
+                pltpu.make_async_copy(
+                    vflat_ref.at[pl.ds(row, 1), :],
+                    scratch.at[pl.ds(b % (2 * NSEM), 1), :],
+                    sems.at[b % NSEM],
+                ).start()
+                return 0
+
+            def body(b, _):
+                pltpu.make_async_copy(
+                    vflat_ref.at[pl.ds(0, 1), :],
+                    scratch.at[pl.ds(b % (2 * NSEM), 1), :],
+                    sems.at[b % NSEM],
+                ).wait()
+                row = (idx_ref[b + NSEM] + r * 977) % rows
+                pltpu.make_async_copy(
+                    vflat_ref.at[pl.ds(row, 1), :],
+                    scratch.at[pl.ds((b + NSEM) % (2 * NSEM), 1), :],
+                    sems.at[(b + NSEM) % NSEM],
+                ).start()
+                return 0
+
+            def drain(b, _):
+                pltpu.make_async_copy(
+                    vflat_ref.at[pl.ds(0, 1), :],
+                    scratch.at[pl.ds(b % (2 * NSEM), 1), :],
+                    sems.at[b % NSEM],
+                ).wait()
+                return 0
+
+            jax.lax.fori_loop(0, NSEM, issue, 0)
+            jax.lax.fori_loop(0, batch - NSEM, body, 0)
+            jax.lax.fori_loop(batch - NSEM, batch, drain, 0)
+            return acc + scratch[0, 0]
+
+        out_ref[0, 0] = jax.lax.fori_loop(0, reps, pass_body, jnp.uint32(0))
+
+    return pl.pallas_call(
+        kernel,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.uint32),
+        scratch_shapes=[
+            pltpu.VMEM((2 * NSEM, 128), jnp.uint32),
+            pltpu.SemaphoreType.DMA((NSEM,)),
+        ],
+    )
+
+
+def main():
+    assert jax.default_backend() != "cpu", "needs the real chip"
+    n, batch = 256, 8192
+    rows = n * batch
+    # fill V on device: a 1 GiB host upload through the tunnel takes
+    # minutes and measures nothing
+    vflat = jax.jit(
+        lambda: (jnp.arange(rows, dtype=jnp.uint32)[:, None]
+                 * jnp.uint32(2654435761)
+                 + jnp.arange(128, dtype=jnp.uint32)[None, :])
+    )()
+    vflat.block_until_ready()
+    rng = np.random.default_rng(0)
+    idx = jnp.asarray(rng.integers(0, rows, batch, dtype=np.int32))
+
+    def best(k, nrun=6):
+        ts = []
+        for _ in range(nrun):
+            t0 = time.perf_counter()
+            int(k(idx, vflat)[0, 0])
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    k_lo = build_gather_kernel(rows, batch, 32)
+    k_hi = build_gather_kernel(rows, batch, 256)
+    int(k_lo(idx, vflat)[0, 0])
+    int(k_hi(idx, vflat)[0, 0])
+    b_lo, b_hi = best(k_lo), best(k_hi)
+    per_pass = (b_hi - b_lo) / (256 - 32)
+    per_row = per_pass / batch
+    print(f"reps=32: {b_lo*1e3:.1f} ms   reps=256: {b_hi*1e3:.1f} ms")
+    print(
+        f"per {batch}-row pass: {per_pass*1e6:.1f} us   "
+        f"per-row: {per_row*1e9:.2f} ns   "
+        f"({512/per_row/1e9:.1f} GB/s on 512B-padded rows, "
+        f"{128/per_row/1e9:.1f} GB/s useful)"
+    )
+    print(
+        "shipping jnp step (gather+unpack+BlockMix+pack) is ~28 ns/row; "
+        "XLA row gather alone ~5.5 ns/row (PERF.md) — "
+        f"verdict: {'REJECT' if per_row > 28e-9 else 'VIABLE'} fused Pallas ROMix"
+    )
+
+
+if __name__ == "__main__":
+    main()
