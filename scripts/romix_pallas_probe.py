@@ -113,14 +113,15 @@ def main():
             ts.append(time.perf_counter() - t0)
         return min(ts)
 
-    k_lo = build_gather_kernel(rows, batch, 32)
-    k_hi = build_gather_kernel(rows, batch, 256)
+    reps_lo, reps_hi = 32, 256
+    k_lo = build_gather_kernel(rows, batch, reps_lo)
+    k_hi = build_gather_kernel(rows, batch, reps_hi)
     int(k_lo(idx, vflat)[0, 0])
     int(k_hi(idx, vflat)[0, 0])
     b_lo, b_hi = best(k_lo), best(k_hi)
-    per_pass = (b_hi - b_lo) / (256 - 32)
+    per_pass = (b_hi - b_lo) / (reps_hi - reps_lo)
     per_row = per_pass / batch
-    print(f"reps=32: {b_lo*1e3:.1f} ms   reps=256: {b_hi*1e3:.1f} ms")
+    print(f"reps={reps_lo}: {b_lo*1e3:.1f} ms   reps={reps_hi}: {b_hi*1e3:.1f} ms")
     print(
         f"per {batch}-row pass: {per_pass*1e6:.1f} us   "
         f"per-row: {per_row*1e9:.2f} ns   "

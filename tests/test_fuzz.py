@@ -1,0 +1,165 @@
+"""Seeded concurrency fuzz of the coordinator (SURVEY.md §5 race-
+detection practice; VERDICT r3 coverage row 22): a randomized fleet —
+honest fast/slow workers, foragers of fake winners, lazy under-
+searchers, random deaths and elastic rejoins — against concurrent
+clients, under transport faults, with hedging and audits enabled. The
+invariant is absolute: every job the clients get an answer for carries
+the exact brute-force minimum, no matter what the fleet did.
+
+The fleet's behavior stream is seeded (random.Random), so a failure
+reproduces by seed; asyncio interleaving still varies run to run, which
+is the point — the scheduler's bookkeeping must hold under any
+interleaving.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from tpuminter import chain
+from tpuminter.client import submit
+from tpuminter.lsp import LspClient, LspConnectionLost
+from tpuminter.protocol import (
+    Assign,
+    Cancel,
+    Join,
+    PowMode,
+    Refuse,
+    Request,
+    Result,
+    Setup,
+    decode_msg,
+    encode_msg,
+)
+
+from tests.test_e2e import FAST, Cluster, brute_min, run
+
+
+async def _actor(port: int, rng: random.Random, behavior: str) -> None:
+    """One fuzz worker. Behaviors:
+
+    - "honest": mines exactly (host brute force), tiny random delays
+    - "slow":   honest but sleepy (hedging fodder)
+    - "liar":   claims found winners with impossible hashes (rejected,
+                eventually evicted)
+    - "lazy":   answers instantly with the verifiable hash of the
+                range's first nonce (audits catch)
+    - "flaky":  honest, but randomly Refuses dispatches (template
+                resync path)
+    """
+    w = await LspClient.connect("127.0.0.1", port, FAST)
+    w.write(encode_msg(Join(backend=behavior, lanes=1)))
+    templates = {}
+    try:
+        while True:
+            msg = decode_msg(await w.read())
+            if isinstance(msg, Setup):
+                templates[msg.request.job_id] = msg.request
+            elif isinstance(msg, Cancel):
+                templates.pop(msg.job_id, None)
+            elif isinstance(msg, Assign):
+                req = templates.get(msg.job_id)
+                if req is None or (behavior == "flaky" and rng.random() < 0.3):
+                    w.write(encode_msg(Refuse(msg.job_id, msg.chunk_id)))
+                    continue
+                if behavior == "liar" and rng.random() < 0.8:
+                    w.write(encode_msg(Result(
+                        msg.job_id, req.mode, nonce=msg.lower, hash_value=0,
+                        found=True, searched=1, chunk_id=msg.chunk_id,
+                    )))
+                    continue
+                if behavior == "lazy":
+                    w.write(encode_msg(Result(
+                        msg.job_id, req.mode, nonce=msg.lower,
+                        hash_value=chain.toy_hash(req.data, msg.lower),
+                        found=True, searched=msg.upper - msg.lower + 1,
+                        chunk_id=msg.chunk_id,
+                    )))
+                    continue
+                if behavior == "slow":
+                    await asyncio.sleep(rng.uniform(0.2, 0.6))
+                else:
+                    await asyncio.sleep(rng.uniform(0.0, 0.02))
+                h, n = brute_min(req.data, msg.lower, msg.upper)
+                w.write(encode_msg(Result(
+                    msg.job_id, req.mode, n, h, found=True,
+                    searched=msg.upper - msg.lower + 1,
+                    chunk_id=msg.chunk_id,
+                )))
+    except (LspConnectionLost, asyncio.CancelledError):
+        pass
+    finally:
+        await w.close(drain_timeout=0.5)
+
+
+@pytest.mark.parametrize("seed", [1, 7])
+def test_scheduler_fuzz_exact_answers_despite_hostile_fleet(seed, monkeypatch):
+    from tpuminter import coordinator as coord_mod
+
+    # full-coverage audits: at the default sampled rate a lazy worker's
+    # chunk legitimately escapes with p ≈ (1 - rate) + rate/sample — the
+    # probabilistic defense working as documented. The fuzz invariant
+    # ("every answer exact") needs the deterministic regime: every
+    # accepted chunk re-mined in full.
+    monkeypatch.setattr(coord_mod, "AUDIT_SAMPLE", 600)
+
+    async def scenario():
+        rng = random.Random(seed)
+        cluster = await Cluster.create(
+            n_miners=0, chunk_size=600,
+            hedge_after=0.4, audit_rate=1.0, audit_seed=seed,
+        )
+        # transport faults on top of everything else
+        ep = cluster.coord._server.endpoint
+        ep.set_fault_rates(drop=0.05, dup=0.05, reorder=0.05)
+        ep.reorder_delay = 0.01
+        actors = []
+
+        def spawn(behavior):
+            actors.append(asyncio.ensure_future(
+                _actor(cluster.coord.port, random.Random(rng.random()),
+                       behavior)
+            ))
+
+        try:
+            # two honest anchors guarantee liveness; the rest is chaos
+            for behavior in ("honest", "honest", "slow", "liar", "lazy",
+                             "flaky"):
+                spawn(behavior)
+            await asyncio.sleep(0.2)
+
+            jobs = []
+            for jid in range(4):
+                data = f"fuzz-{seed}-{jid}".encode()
+                upper = rng.randrange(3_000, 9_000)
+                req = Request(job_id=jid, mode=PowMode.MIN, lower=0,
+                              upper=upper, data=data)
+                jobs.append((data, upper, asyncio.ensure_future(
+                    submit("127.0.0.1", cluster.coord.port, req, params=FAST)
+                )))
+                await asyncio.sleep(rng.uniform(0.0, 0.2))
+
+            # mid-flight churn: kill a random actor, add replacements
+            await asyncio.sleep(0.3)
+            victim = actors[rng.randrange(len(actors))]
+            victim.cancel()
+            spawn("honest")
+            spawn("flaky")
+
+            for data, upper, task in jobs:
+                result = await asyncio.wait_for(task, 90.0)
+                assert (result.hash_value, result.nonce) == brute_min(
+                    data, 0, upper
+                ), data
+            # the scheduler saw real adversity (not a vacuous pass)
+            stats = cluster.coord.stats
+            assert stats["results_rejected"] >= 1  # the liar fired
+            assert stats["jobs_done"] == 4
+        finally:
+            for a in actors:
+                a.cancel()
+            await asyncio.gather(*actors, return_exceptions=True)
+            await cluster.close()
+
+    run(scenario(), timeout=120.0)

@@ -173,18 +173,12 @@ def romix(x: jnp.ndarray, n_log2: int) -> jnp.ndarray:
     lane = jnp.arange(batch, dtype=jnp.uint32)
     words = tuple(x[:, i] for i in range(32))
 
-    # unroll=2 on TPU: measured +11.5% on the v5e at the shipping
-    # B=16384 (20.0 → 22.3 kH/s; +15% at B=8192) — halves the
-    # per-iteration loop overhead and lets XLA overlap gathers across
-    # steps. Deeper unrolls regress (unroll=4: 20.2 kH/s) while compile
-    # time grows. Kept at 1 on the CPU mesh: CI pays the doubled scan-
-    # body compile on every cache miss for zero benefit (the knob only
-    # reschedules; the math is identical). A fully-fused Pallas ROMix
-    # was prototyped and REJECTED on measurement: its per-lane
-    # scalar-DMA gather costs 38.7 ns/row (scripts/romix_pallas_probe
-    # .py) — 1.4× this entire step's ~28 ns/row before any compute —
-    # because Mosaic has no vectorized cross-lane HBM gather and its
-    # 128-element minor-slice alignment pads rows to 512 bytes.
+    # unroll=2 on TPU: measured +11.5% at the shipping B=16384 (unroll=4
+    # regresses); kept at 1 on the CPU mesh where CI would pay a doubled
+    # scan-body compile for zero benefit (the knob only reschedules; the
+    # math is identical). A fully-fused Pallas ROMix was prototyped and
+    # rejected on measurement — see PERF.md's scrypt section and
+    # scripts/romix_pallas_probe.py for the numbers.
     unroll = 2 if jax.default_backend() != "cpu" else 1
 
     def fill(carry, _):
