@@ -208,6 +208,38 @@ def bench_scrypt(batch: int, steps: int = 4) -> float:
     return batch * steps / (time.perf_counter() - t0)
 
 
+def bench_pod(span: int = 1 << 32) -> float:
+    """Production pod path (PodMiner → striped candidate sweep with the
+    per-stripe or-reduce) per-chip rate, on however many chips this
+    process sees (one, on this image). PERF.md's claim that the pod
+    path's per-chip rate matches the single-chip pipeline is recorded
+    here as a measurement, not prose. Target=1 is unbeatable, so the
+    sweep exhausts ``span`` nonces exactly."""
+    from tpuminter.pod_worker import PodMiner
+    from tpuminter.protocol import PowMode, Request
+
+    miner = PodMiner()
+
+    def drain(req):
+        last = None
+        for item in miner.mine(req):
+            if item is not None:
+                last = item
+        assert last is not None and not last.found  # unbeatable target
+        return last
+
+    hdr = chain.GENESIS_HEADER.pack()
+    # compile + warm: one full pod span
+    drain(Request(job_id=98, mode=PowMode.TARGET, lower=0,
+                  upper=miner.pod_span - 1, header=hdr, target=1))
+    req = Request(job_id=99, mode=PowMode.TARGET, lower=0,
+                  upper=span - 1, header=hdr, target=1)
+    t0 = time.perf_counter()
+    drain(req)
+    dt = time.perf_counter() - t0
+    return span / dt / miner.n_dev
+
+
 def bench_jnp(batch: int, secs: float = 1.0) -> float:
     template = ops.header_template(chain.GENESIS_HEADER.pack())
     target_words = jnp.asarray(ops.target_to_words(1))
@@ -241,6 +273,7 @@ def main() -> None:
     else:
         rate = bench_pipeline()
         extra = bench_time_to_block()
+        extra["pod_ghs_per_chip"] = round(bench_pod() / 1e9, 3)
         extra["scrypt_khs_per_chip"] = round(bench_scrypt(16384) / 1e3, 3)
     ghs = rate / 1e9
     print(
