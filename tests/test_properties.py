@@ -1,0 +1,293 @@
+"""Property-based tests (hypothesis) over the framework's pure seams.
+
+SURVEY.md §4's load-bearing test idea is "own the transport seam, inject
+faults at it"; the asyncio suite (tests/test_lsp.py, tests/test_fuzz.py)
+does that with real sockets and timers. This module pushes the same
+invariants through *deterministic, timer-free* state-machine drives so
+hypothesis can shrink any violation to a minimal schedule:
+
+- the frame codec round-trips arbitrary frames and rejects every
+  single-byte corruption (CRC-32 catches all ≤32-bit bursts);
+- two :class:`~tpuminter.lsp.connection.ConnState` machines wired
+  through an in-memory channel deliver every written message exactly
+  once, in order, under arbitrary drop/duplicate/reorder schedules and
+  arbitrary message sizes (fragmentation boundaries included);
+- ``chain.rolled_segments`` tiles any global-index range exactly;
+- the app-protocol codec round-trips every message type, rolled
+  Requests included.
+"""
+
+import random
+from collections import deque
+
+from hypothesis import given, settings, strategies as st
+
+from tpuminter import chain
+from tpuminter.lsp.connection import FRAGMENT_SIZE, ConnState
+from tpuminter.lsp.message import MAX_PAYLOAD, Frame, MsgType, decode, encode
+from tpuminter.lsp.params import Params
+from tpuminter.protocol import (
+    Assign,
+    Cancel,
+    Join,
+    PowMode,
+    Refuse,
+    Request,
+    Result,
+    Setup,
+    decode_msg,
+    encode_msg,
+)
+
+# ---------------------------------------------------------------------------
+# frame codec
+# ---------------------------------------------------------------------------
+
+frames = st.builds(
+    Frame,
+    type=st.sampled_from(list(MsgType)),
+    conn_id=st.integers(0, 2**32 - 1),
+    seq=st.integers(0, 2**32 - 1),
+    payload=st.binary(max_size=MAX_PAYLOAD),
+)
+
+
+@given(frames)
+def test_codec_roundtrip(frame):
+    assert decode(encode(frame)) == frame
+
+
+@given(frames, st.data())
+def test_codec_rejects_any_single_byte_corruption(frame, data):
+    wire = bytearray(encode(frame))
+    i = data.draw(st.integers(0, len(wire) - 1))
+    flip = data.draw(st.integers(1, 255))
+    wire[i] ^= flip
+    assert decode(bytes(wire)) is None
+
+
+@given(frames, st.integers(0, MAX_PAYLOAD + 14))
+def test_codec_rejects_any_truncation(frame, keep):
+    wire = encode(frame)
+    if keep < len(wire):
+        assert decode(wire[:keep]) is None
+
+
+# ---------------------------------------------------------------------------
+# ConnState pair under hostile frame schedules (timer-free model drive)
+# ---------------------------------------------------------------------------
+
+#: Message sizes that cross every fragmentation boundary.
+_SIZES = st.one_of(
+    st.integers(0, 64),
+    st.sampled_from(
+        [FRAGMENT_SIZE - 1, FRAGMENT_SIZE, FRAGMENT_SIZE + 1,
+         2 * FRAGMENT_SIZE, 2 * FRAGMENT_SIZE + 1, 3500]
+    ),
+)
+
+
+def _payload(size: int, seed: int) -> bytes:
+    return random.Random(seed).randbytes(size)
+
+
+@settings(max_examples=60, deadline=None)
+@given(
+    msgs_a=st.lists(st.tuples(_SIZES, st.integers(0, 2**16)), max_size=8),
+    msgs_b=st.lists(st.tuples(_SIZES, st.integers(0, 2**16)), max_size=8),
+    window=st.integers(1, 8),
+    max_backoff=st.integers(0, 3),
+    drop=st.floats(0.0, 0.5),
+    dup=st.floats(0.0, 0.3),
+    reorder=st.floats(0.0, 0.3),
+    seed=st.integers(0, 2**32),
+)
+def test_connstate_exactly_once_in_order_under_faults(
+    msgs_a, msgs_b, window, max_backoff, drop, dup, reorder, seed
+):
+    rng = random.Random(seed)
+    params = Params(
+        epoch_limit=10**9,  # liveness is not under test; loss must not fire
+        epoch_millis=1,
+        window_size=window,
+        max_backoff_interval=max_backoff,
+        max_unacked_messages=window,
+    )
+    channel = deque()  # (dest_name, Frame) in flight
+    recv = {"a": [], "b": []}
+
+    def make(name, peer_name):
+        return ConnState(
+            conn_id=7,
+            params=params,
+            send_frame=lambda f, d=peer_name: channel.append((d, f)),
+            deliver=recv[name].append,
+            on_lost=lambda reason: (_ for _ in ()).throw(
+                AssertionError(f"conn lost during model drive: {reason}")
+            ),
+        )
+
+    conns = {}
+    conns["a"] = make("a", "b")
+    conns["b"] = make("b", "a")
+
+    sent_a = [_payload(s, sd) for s, sd in msgs_a]
+    sent_b = [_payload(s, sd) for s, sd in msgs_b]
+    # per-side write order is the delivery contract; the rng interleaves
+    # WHICH side writes next, never the order within a side
+    todo = {"a": deque(sent_a), "b": deque(sent_b)}
+
+    def pump_one_faulty():
+        dest, frame = channel.popleft()
+        r = rng.random()
+        if r < drop:
+            return
+        if r < drop + dup:
+            conns[dest].on_frame(frame)
+            conns[dest].on_frame(frame)
+            return
+        if r < drop + dup + reorder and channel:
+            channel.append((dest, frame))  # overtaken by everything queued
+            return
+        conns[dest].on_frame(frame)
+
+    # Phase 1 — hostile: interleave writes, faulty delivery, epochs.
+    steps = 0
+    while todo["a"] or todo["b"] or channel:
+        steps += 1
+        assert steps < 100_000
+        act = rng.random()
+        sides = [s for s in "ab" if todo[s]]
+        if sides and act < 0.3:
+            side = rng.choice(sides)
+            conns[side].write(todo[side].popleft())
+        elif channel and act < 0.8:
+            pump_one_faulty()
+        else:
+            conns[rng.choice("ab")].on_epoch()
+
+    # Phase 2 — drain faithfully: every queued frame delivered, epochs
+    # tick so retransmit backoff elapses. Quiesce = nothing in flight.
+    for _ in range(10_000):
+        while channel:
+            dest, frame = channel.popleft()
+            conns[dest].on_frame(frame)
+        if not conns["a"].in_flight and not conns["b"].in_flight:
+            if not conns["a"]._pending and not conns["b"]._pending:
+                if not channel:
+                    break
+        conns["a"].on_epoch()
+        conns["b"].on_epoch()
+    else:
+        raise AssertionError("model drive failed to quiesce")
+
+    assert recv["b"] == sent_a
+    assert recv["a"] == sent_b
+    assert not conns["a"].lost and not conns["b"].lost
+
+
+# ---------------------------------------------------------------------------
+# rolled-segment arithmetic
+# ---------------------------------------------------------------------------
+
+@given(
+    nonce_bits=st.integers(1, 32),
+    en_lo=st.integers(0, 1000),
+    en_span=st.integers(0, 6),
+    data=st.data(),
+)
+def test_rolled_segments_tile_the_range_exactly(nonce_bits, en_lo, en_span, data):
+    mask = (1 << nonce_bits) - 1
+    lo_off = data.draw(st.integers(0, mask))
+    hi_off = data.draw(st.integers(0, mask))
+    lower = (en_lo << nonce_bits) | lo_off
+    upper = ((en_lo + en_span) << nonce_bits) | hi_off
+    if upper < lower:
+        upper = lower
+    segs = list(chain.rolled_segments(lower, upper, nonce_bits))
+    # segments are contiguous, cover [lower, upper] exactly, and each
+    # (en, base, n_lo, n_hi) is internally consistent
+    expect = lower
+    for en, base, n_lo, n_hi in segs:
+        assert base == en << nonce_bits
+        assert 0 <= n_lo <= n_hi <= mask
+        assert base | n_lo == expect
+        expect = (base | n_hi) + 1
+    assert expect == upper + 1
+
+
+# ---------------------------------------------------------------------------
+# app-protocol codec
+# ---------------------------------------------------------------------------
+
+_GENESIS80 = chain.GENESIS_HEADER.pack()
+
+plain_requests = st.builds(
+    Request,
+    job_id=st.integers(0, 2**31),
+    mode=st.just(PowMode.TARGET),
+    lower=st.integers(0, 1000),
+    upper=st.integers(1000, 2**32 - 1),
+    header=st.just(_GENESIS80),
+    target=st.integers(1, 2**256 - 1),
+    chunk_id=st.integers(0, 2**31),
+)
+
+min_requests = st.builds(
+    Request,
+    job_id=st.integers(0, 2**31),
+    mode=st.just(PowMode.MIN),
+    lower=st.integers(0, 1000),
+    upper=st.integers(1000, 2**64 - 1),
+    data=st.binary(max_size=64),
+)
+
+rolled_requests = st.builds(
+    Request,
+    job_id=st.integers(0, 2**31),
+    mode=st.just(PowMode.TARGET),
+    lower=st.just(0),
+    upper=st.integers(0, 2**32 - 1),
+    header=st.just(_GENESIS80),
+    target=st.integers(1, 2**256 - 1),
+    coinbase_prefix=st.binary(min_size=1, max_size=300),
+    coinbase_suffix=st.binary(max_size=300),
+    extranonce_size=st.integers(1, 4),
+    branch=st.lists(st.binary(min_size=32, max_size=32), max_size=13).map(tuple),
+)
+
+messages = st.one_of(
+    st.builds(Join, backend=st.text(max_size=16), lanes=st.integers(1, 2**20)),
+    plain_requests,
+    min_requests,
+    rolled_requests,
+    st.builds(
+        Result,
+        job_id=st.integers(0, 2**31),
+        mode=st.sampled_from([PowMode.MIN, PowMode.TARGET, PowMode.SCRYPT]),
+        nonce=st.integers(0, 2**64 - 1),
+        hash_value=st.integers(0, 2**256 - 1),
+        found=st.booleans(),
+        searched=st.integers(0, 2**64 - 1),
+        chunk_id=st.integers(0, 2**31),
+    ),
+    plain_requests.map(Setup),
+    rolled_requests.map(Setup),
+    st.builds(
+        Assign,
+        job_id=st.integers(0, 2**31),
+        chunk_id=st.integers(0, 2**31),
+        lower=st.integers(0, 2**32 - 1),
+        upper=st.integers(0, 2**64 - 1),
+    ),
+    st.builds(
+        Refuse, job_id=st.integers(0, 2**31), chunk_id=st.integers(0, 2**31)
+    ),
+    st.builds(Cancel, job_id=st.integers(0, 2**31)),
+)
+
+
+@settings(max_examples=200)
+@given(messages)
+def test_protocol_roundtrip(msg):
+    assert decode_msg(encode_msg(msg)) == msg
