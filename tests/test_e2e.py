@@ -899,3 +899,80 @@ def test_one_client_connection_many_jobs():
             await cluster.close()
 
     run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# pipelined-worker dispatch granularity (Join.span)
+# ---------------------------------------------------------------------------
+
+def test_span_hint_sizes_chunks_to_multiple_spans():
+    """A worker advertising a pipeline span gets chunks covering
+    SPANS_PER_DISPATCH spans, so its slab pipeline never drains at a
+    chunk boundary (PERF.md: single-span dispatch measured 9% slower);
+    a lanes=1 budget of chunk_size=600 would otherwise carve 600-nonce
+    crumbs for this device-class miner."""
+    sizes = []
+
+    class SpanMiner(CpuMiner):
+        span = 5_000
+
+        def mine(self, request):
+            sizes.append(request.upper - request.lower + 1)
+            yield from super().mine(request)
+
+    async def scenario():
+        cluster = await Cluster.create(
+            n_miners=1, chunk_size=600, miner_factory=SpanMiner
+        )
+        try:
+            req = Request(job_id=3, mode=PowMode.MIN, lower=0, upper=99_999,
+                          data=b"span hint")
+            result = await submit(
+                "127.0.0.1", cluster.coord.port, req, params=FAST
+            )
+            want_hash, want_nonce = brute_min(b"span hint", 0, 99_999)
+            assert (result.hash_value, result.nonce) == (want_hash, want_nonce)
+        finally:
+            await cluster.close()
+
+    run(scenario())
+    from tpuminter.coordinator import SPANS_PER_DISPATCH
+
+    assert sizes, "miner never received a chunk"
+    assert sum(sizes) == 100_000
+    assert min(sizes) >= SPANS_PER_DISPATCH * SpanMiner.span
+
+
+def test_huge_span_hint_cannot_monopolize_a_job():
+    """lanes/span are unvalidated wire hints: a worker advertising an
+    absurd span still never gets more than half a job in one dispatch,
+    so a second worker can always participate (and a hedge backup's
+    size class can always cover any chunk)."""
+    sizes = []
+
+    class GreedyMiner(CpuMiner):
+        span = 1 << 31
+
+        def mine(self, request):
+            sizes.append(request.upper - request.lower + 1)
+            yield from super().mine(request)
+
+    async def scenario():
+        cluster = await Cluster.create(
+            n_miners=1, chunk_size=600, miner_factory=GreedyMiner
+        )
+        try:
+            req = Request(job_id=4, mode=PowMode.MIN, lower=0, upper=99_999,
+                          data=b"greedy")
+            result = await submit(
+                "127.0.0.1", cluster.coord.port, req, params=FAST
+            )
+            want = brute_min(b"greedy", 0, 99_999)
+            assert (result.hash_value, result.nonce) == want
+        finally:
+            await cluster.close()
+
+    run(scenario())
+    assert len(sizes) >= 2
+    assert max(sizes) <= 50_000
+    assert sum(sizes) == 100_000

@@ -257,7 +257,12 @@ rolled_requests = st.builds(
 )
 
 messages = st.one_of(
-    st.builds(Join, backend=st.text(max_size=16), lanes=st.integers(1, 2**20)),
+    st.builds(
+        Join,
+        backend=st.text(max_size=16),
+        lanes=st.integers(1, 2**20),
+        span=st.integers(0, 2**32),
+    ),
     plain_requests,
     min_requests,
     rolled_requests,

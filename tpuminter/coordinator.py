@@ -62,6 +62,14 @@ log = logging.getLogger("tpuminter.coordinator")
 #: their advertised lane count.
 DEFAULT_CHUNK_SIZE = 16_384
 
+#: Minimum pipeline spans per dispatch to a worker that advertises one
+#: (Join.span > 0). A pipelined device worker (depth-2 slab/pod-span
+#: pipeline) drains at every chunk boundary; a chunk of exactly one span
+#: never overlaps dispatch with compute at all. Measured on one v5e:
+#: single-span dispatch costs 9% of throughput at a 2^30 span vs 2% when
+#: several spans amortize the fill (PERF.md, pod striping section).
+SPANS_PER_DISPATCH = 4
+
 
 #: unverifiable Results tolerated per miner before it is evicted — bounds
 #: the requeue ping-pong a deterministically-buggy backend could otherwise
@@ -130,6 +138,9 @@ class _MinerState:
     conn_id: int
     backend: str
     lanes: int
+    #: worker's internal pipeline-stage size in nonces (Join.span);
+    #: 0 = not pipelined (see SPANS_PER_DISPATCH)
+    span: int = 0
     #: (chunk_id, job_id, lower, upper) currently assigned, or None if
     #: idle. The chunk_id lets a Result be matched to the exact dispatch
     #: it answers: after a Cancel races a completion, a stale Result must
@@ -414,8 +425,13 @@ class Coordinator:
     def _on_join(self, conn_id: int, msg: Join) -> None:
         if conn_id in self._miners:
             return  # duplicate Join: already registered
-        self._miners[conn_id] = _MinerState(conn_id, msg.backend, max(1, msg.lanes))
-        log.info("miner %d joined (backend=%s, lanes=%d)", conn_id, msg.backend, msg.lanes)
+        self._miners[conn_id] = _MinerState(
+            conn_id, msg.backend, max(1, msg.lanes), span=max(0, msg.span)
+        )
+        log.info(
+            "miner %d joined (backend=%s, lanes=%d, span=%d)",
+            conn_id, msg.backend, msg.lanes, msg.span,
+        )
         self._dispatch()
 
     def _release_assignment(self, conn_id: int, miner: _MinerState) -> None:
@@ -944,8 +960,19 @@ class Coordinator:
         """Per-dispatch nonce budget for this (miner, dialect) pair."""
         budget = self._chunk_size * miner.lanes
         if job.request.mode == PowMode.SCRYPT:
+            # span describes the fast-dialect pipeline; scrypt steps are
+            # divisor-scaled separately and stay small for prompt cancel
             budget = max(SCRYPT_MIN_CHUNK, budget // SCRYPT_CHUNK_DIVISOR)
-        return budget
+        elif miner.span > 1:
+            budget = max(budget, SPANS_PER_DISPATCH * miner.span)
+        # One dispatch never exceeds half the job: lanes/span are
+        # unvalidated wire hints, and a worker advertising huge ones
+        # would otherwise take whole jobs as single chunks that no other
+        # miner's size class could hedge — a stalled-but-alive worker
+        # could then hold a job hostage. Half-job keeps at least two
+        # carves per job, so a second worker can always participate.
+        req = job.request
+        return min(budget, max(1, (req.upper - req.lower + 2) // 2))
 
     def _assign(self, miner: _MinerState, job: _Job, lo: int, hi: int) -> bool:
         """Book-keep + write one chunk dispatch; False if the write

@@ -141,6 +141,7 @@ class PodMiner(Miner):
             else max(self.n_dev, self.n_dev * (slab_per_device * 4) // 16_384)
         )
         self.exact_min = exact_min
+        self.span = self.pod_span
         #: multi-host mode: this process is the control-plane leader and
         #: mirrors its request/step stream to follower processes (see
         #: module docstring of ``parallel.distributed``)

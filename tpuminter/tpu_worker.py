@@ -119,6 +119,7 @@ class TpuMiner(Miner):
         self._scrypt_delegate = None
         # scheduler hint: ask for chunks a few slabs deep
         self.lanes = lanes if lanes is not None else (slab * 4) // 16_384
+        self.span = slab
 
     def mine(self, request: Request) -> Iterator[Optional[Result]]:
         if request.mode == PowMode.MIN:

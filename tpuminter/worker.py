@@ -64,6 +64,11 @@ class Miner:
 
     backend = "abstract"
     lanes = 1
+    #: internal pipeline-stage size in nonces (Join.span): device miners
+    #: that keep several slabs in flight set this so the coordinator
+    #: carves chunks covering multiple spans (single-span chunks drain
+    #: the pipeline at every boundary — coordinator.SPANS_PER_DISPATCH)
+    span = 0
 
     def mine(self, request: Request) -> Iterator[Optional[Result]]:
         raise NotImplementedError
@@ -210,6 +215,7 @@ class ProfiledMiner(Miner):
         self._tracing = False
         self.backend = inner.backend
         self.lanes = inner.lanes
+        self.span = inner.span
 
     def _stop_trace(self) -> None:
         import jax
@@ -287,7 +293,9 @@ async def run_miner(
     any other message read mid-mine is queued and handled after.
     """
     client = await LspClient.connect(host, port, params or FAST)
-    client.write(encode_msg(Join(backend=miner.backend, lanes=miner.lanes)))
+    client.write(encode_msg(
+        Join(backend=miner.backend, lanes=miner.lanes, span=miner.span)
+    ))
     pending: "asyncio.Queue[Message]" = asyncio.Queue()
     read_task: Optional[asyncio.Task] = None
     #: job_id → template Request from a Setup (insertion-ordered so the
