@@ -61,6 +61,13 @@ def init_from_env() -> bool:
             addr,
             num_processes=int(os.environ[ENV_NPROCS]),
             process_id=int(os.environ[ENV_PID]),
+            # an orphaned process (its peer crashed mid-collective) must
+            # self-terminate promptly — the coordinator has already
+            # requeued the pod's chunk, so a hung follower is pure leak;
+            # jax's default 100 s is tuned for flaky DCN, not localhost
+            heartbeat_timeout_seconds=int(
+                os.environ.get("TPUMINTER_HEARTBEAT_S", "30")
+            ),
         )
     return jax.process_count() > 1
 
