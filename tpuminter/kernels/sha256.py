@@ -25,9 +25,11 @@ dependency chains in flight. Rotations lower to shift/or pairs
 
 The kernels set ``interpret=True`` on the CPU backend, but the unrolled
 ~6k-op bodies make interpreter-mode execution impractically slow beyond
-tiny shapes; CPU CI pins the *generator* (``ops.symbolic``) against the
-jnp path instead, and tests/test_kernels_tpu.py exercises the compiled
-kernels on a real chip (see that module's rationale).
+tiny shapes (measured round 4: one minimum-size 1024-nonce
+``pallas_sha256_batch`` did not finish in 400 s on this host); CPU CI
+pins the *generator* (``ops.symbolic``) against the jnp path instead,
+and tests/test_kernels_tpu.py exercises the compiled kernels on a real
+chip (see that module's rationale).
 """
 
 from __future__ import annotations
