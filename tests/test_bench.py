@@ -1,0 +1,33 @@
+"""The driver's bench contract: ``python bench.py`` must print exactly
+ONE JSON line with the agreed shape, whatever backend it lands on. A
+stray print, an import error, or a schema drift here would silently
+void the round's recorded benchmark, so CI pins the smoke path
+(``BENCH_SMOKE=1`` forces the CPU measurement; the TPU path shares all
+the surrounding plumbing and is exercised on the real chip)."""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def test_bench_smoke_emits_one_json_line():
+    env = dict(os.environ)
+    env["BENCH_SMOKE"] = "1"
+    proc = subprocess.run(
+        [sys.executable, "bench.py"],
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line: {lines}"
+    obj = json.loads(lines[0])
+    assert obj["metric"] == "double_sha256_ghs_per_chip"
+    assert obj["unit"] == "GH/s"
+    assert obj["value"] > 0
+    assert obj["vs_baseline"] == obj["value"]  # target denominator is 1.0
+    assert obj["extra"]["scrypt_khs_per_chip"] > 0
