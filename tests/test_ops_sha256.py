@@ -183,3 +183,44 @@ def test_e60_e61_scalar_constant_folds_to_chain():
         w7, w6 = struct.unpack(">8I", digest)[7], struct.unpack(">8I", digest)[6]
         assert (sym.CAND_E60 == e60) == (w7 == 0)
         assert (sym.DIGEST6_BIAS + e61) & 0xFFFFFFFF == w6
+
+
+def test_e60_e61_op_count_stays_at_the_partial_eval_floor():
+    """PERF.md: the candidate test traces to 5,939 ops per nonce batch —
+    the structural floor of the 61+61 variable SHA rounds after symbolic
+    partial evaluation (midstate, constant early rounds, K+W folds). CI
+    cannot measure GH/s, but it can catch a folding regression: if this
+    count creeps up, the kernel slows proportionally on hardware. A 3%
+    headroom absorbs jax-version tracing drift; raise the bound only
+    with a measured bench justifying it."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpuminter import chain
+    from tpuminter.ops import sha256 as ops
+    from tpuminter.ops import symbolic as sym
+
+    tmpl = ops.header_template(chain.GENESIS_HEADER.pack())
+
+    def f(nonces):
+        return sym.double_sha256_e60_e61(tmpl, jnp.uint32(0), nonces)
+
+    jaxpr = jax.make_jaxpr(f)(jnp.arange(128, dtype=jnp.uint32))
+
+    def count(jx):
+        n = 0
+        for eq in jx.eqns:
+            n += 1
+            for sub in eq.params.values():
+                # higher-order primitives carry sub-jaxprs either bare
+                # (scan/while 'jaxpr') or in sequences (cond 'branches')
+                for item in sub if isinstance(sub, (tuple, list)) else (sub,):
+                    if hasattr(item, "jaxpr"):
+                        n += count(item.jaxpr)
+        return n
+
+    n = count(jaxpr.jaxpr)
+    assert n <= int(5939 * 1.03), (
+        f"symbolic partial evaluation regressed: {n} ops (floor 5939) — "
+        "the Pallas kernel's throughput scales with this count"
+    )
