@@ -976,3 +976,36 @@ def test_huge_span_hint_cannot_monopolize_a_job():
     assert len(sizes) >= 2
     assert max(sizes) <= 50_000
     assert sum(sizes) == 100_000
+
+
+def test_client_sees_disconnected_when_coordinator_dies_mid_job():
+    """Reference UX (SURVEY.md §3.1): a client blocked on its Result
+    must learn of coordinator death through epoch liveness — submit
+    raises LspConnectionLost (the CLI prints ``Disconnected`` on it,
+    client.py:148) rather than hanging forever on a queued job."""
+    from tpuminter.lsp import LspConnectionLost
+
+    async def scenario():
+        cluster = await Cluster.create(n_miners=0)  # job queues forever
+        job = asyncio.ensure_future(submit(
+            "127.0.0.1", cluster.coord.port,
+            Request(job_id=9, mode=PowMode.MIN, lower=0, upper=10**6,
+                    data=b"orphaned job"),
+            params=FAST,
+        ))
+        closed = False
+        try:
+            await asyncio.sleep(0.3)  # connect + submit land
+            assert not job.done()
+            await cluster.close()  # coordinator dies, no goodbye
+            closed = True
+            with pytest.raises(LspConnectionLost):
+                await asyncio.wait_for(job, timeout=30)
+        finally:
+            if not closed:
+                await cluster.close()
+            if not job.done():
+                job.cancel()
+            await asyncio.gather(job, return_exceptions=True)
+
+    run(scenario())
