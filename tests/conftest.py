@@ -27,3 +27,15 @@ jax.config.update("jax_platforms", "cpu")
 # across test runs so only the first run pays the compile bill.
 jax.config.update("jax_compilation_cache_dir", "/tmp/tpuminter-jax-cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+# Property tests: this box has a single CPU core (BASELINE.md), so a
+# scheduling hiccup under load can blow hypothesis's default 200 ms
+# per-example deadline on tests that are microseconds-fast when quiet.
+# Deadlines guard against slow *examples*, not slow *hosts* — disable.
+try:
+    from hypothesis import settings
+
+    settings.register_profile("tpuminter", deadline=None)
+    settings.load_profile("tpuminter")
+except ImportError:  # hypothesis is an optional test extra
+    pass

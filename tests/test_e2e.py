@@ -996,7 +996,10 @@ def test_client_sees_disconnected_when_coordinator_dies_mid_job():
         closed = False
         try:
             await asyncio.sleep(0.3)  # connect + submit land
-            assert not job.done()
+            assert not job.done(), (
+                f"submit finished early: "
+                f"{job.exception() if not job.cancelled() else 'cancelled'}"
+            )
             await cluster.close()  # coordinator dies, no goodbye
             closed = True
             with pytest.raises(LspConnectionLost):
