@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Decompose the scrypt walk step's wall-clock on the real chip.
+
+PERF.md records the shipping ROMix at ~380 us per step AVERAGED over
+fill+walk (B=16384, unroll=2); this probe splits one WALK step (the
+expensive kind) into its additive components via five scan variants:
+
+  loop      — scan body = carry + 1 (per-iteration floor of lax.scan
+              on this backend)
+  gather    — loop + the flat row-gather, folded into the carry via a
+              dense row-reduce (no per-word extracts)
+  extracts  — loop + gather + the 32 ``vj[:, i]`` column extracts + xor
+              (the (B,32)->32x(B,) "unpack"; strided cross-lane ops)
+  salsa     — loop + _block_mix_words on the carry (no gather at all)
+  full      — the shipping walk body (gather + extracts + xor + salsa)
+
+All variants keep the carry data-dependent on their own work so XLA
+cannot hoist anything out of the scan. Additivity check: full should
+be close to extracts + salsa - loop.
+
+Run on the real chip: ``python scripts/romix_step_decomposition.py``.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/tpuminter-jax-cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from tpuminter.ops.scrypt import _block_mix_words  # noqa: E402
+
+B = 16384
+N = 1024
+UNROLL = 2
+STEPS = N  # one walk phase's worth
+
+
+def timed(fn, x, vflat, reps=3):
+    out = fn(x, vflat)
+    np.asarray(jax.tree.leaves(out)[0])  # hard warmup sync, same as below
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(x, vflat)
+        np.asarray(jax.tree.leaves(out)[0])  # hard sync (PERF.md: block_until_ready unreliable)
+        best = min(best, time.perf_counter() - t0)
+    return best / STEPS
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x0 = jnp.asarray(rng.integers(0, 2**32, (B, 32), dtype=np.uint32))
+    # V as a jit ARGUMENT, not a captured constant (a 2 GiB closure
+    # constant explodes lowering time and memory)
+    vflat = jnp.asarray(rng.integers(0, 2**32, (N * B, 32), dtype=np.uint32))
+    lane = jnp.arange(B, dtype=jnp.uint32)
+
+    def scan(body):
+        @jax.jit
+        def run(x, v):
+            words = tuple(x[:, i] for i in range(32))
+            words, _ = jax.lax.scan(
+                lambda c, _: body(c, v), words, None,
+                length=STEPS, unroll=UNROLL,
+            )
+            return words[0]
+        return run
+
+    def body_loop(carry, v):
+        return tuple(c + np.uint32(1) for c in carry), None
+
+    def gather_row(carry, v):
+        j = carry[16] & np.uint32(N - 1)
+        return v[(j * np.uint32(B) + lane).astype(jnp.int32)]
+
+    def body_gather(carry, v):
+        vj = gather_row(carry, v)
+        s = vj.sum(axis=1, dtype=jnp.uint32)  # dense row fold, no extracts
+        out = list(carry)
+        # fold into word 16 so the NEXT step's gather index chases this
+        # step's data — a loop-invariant j would measure constant-address
+        # gathers (and invite hoisting), not the pointer walk
+        out[16] = out[16] ^ s
+        return tuple(out), None
+
+    def body_extracts(carry, v):
+        vj = gather_row(carry, v)
+        return tuple(c ^ vj[:, i] for i, c in enumerate(carry)), None
+
+    def body_salsa(carry, v):
+        return tuple(_block_mix_words(list(carry))), None
+
+    def body_full(carry, v):
+        vj = gather_row(carry, v)
+        mixed = [c ^ vj[:, i] for i, c in enumerate(carry)]
+        return tuple(_block_mix_words(mixed)), None
+
+    results = {}
+    for name, body in [
+        ("loop", body_loop),
+        ("gather", body_gather),
+        ("extracts", body_extracts),
+        ("salsa", body_salsa),
+        ("full", body_full),
+    ]:
+        t = timed(scan(body), x0, vflat)
+        results[name] = t
+        print(f"{name:9s} {t * 1e6:8.1f} us/step")
+
+    loop = results["loop"]
+    print("\ncomponents (us/step):")
+    print(f"  loop floor       {loop * 1e6:8.1f}")
+    print(f"  row gather       {(results['gather'] - loop) * 1e6:8.1f}")
+    print(f"  32 col extracts  {(results['extracts'] - results['gather']) * 1e6:8.1f}")
+    print(f"  blockmix (salsa) {(results['salsa'] - loop) * 1e6:8.1f}")
+    additive = results["extracts"] + results["salsa"] - loop
+    print(f"  additivity: extracts+salsa-loop = {additive * 1e6:.1f} "
+          f"vs full = {results['full'] * 1e6:.1f}")
+
+
+if __name__ == "__main__":
+    main()
