@@ -225,7 +225,10 @@ def bench_pod(span: int = 1 << 32) -> float:
         for item in miner.mine(req):
             if item is not None:
                 last = item
-        assert last is not None and not last.found  # unbeatable target
+        # measurement validity gate — a real error, not an assert, so a
+        # broken/early-exiting drain can't report a bogus rate under -O
+        if last is None or last.found:
+            raise RuntimeError(f"pod sweep did not exhaust cleanly: {last}")
         return last
 
     hdr = chain.GENESIS_HEADER.pack()

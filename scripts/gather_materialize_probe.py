@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Is the 550 us the gather's MATERIALIZATION, not a layout copy?
+
+Round 4 measured the walk's row-gather at 29 us — but in that variant
+the gather FUSED into a row-reduce (vj.sum(axis=1)) and its (B,32)
+output never materialized as a buffer.  Every pallas variant since
+(probes round 5) pays ~550 us regardless of kernel content, and the
+HLO always shows the gather materializing a 2 MB buffer plus a layout
+op.  Hypothesis H-mat: writing the gathered rows out as a standalone
+(B,32) buffer is itself the 3.6 GB/s-class op; H-layout: the write is
+fine and the layout conversion to the custom call's default layout is
+the cost.
+
+Scans (1024 steps, carry-chained, us/step):
+  fused_reduce  — gather + vj.sum(axis=1) folded into the carry
+                  (round-4 baseline; no materialization).
+  barrier_mat   — gather -> optimization_barrier (forces a buffer) ->
+                  sum folded into carry.  H-mat predicts ~670.
+  barrier_tr    — gather -> barrier -> transpose -> reshape ->
+                  (32,128,128) -> sum: materialize THEN the logical
+                  transpose; if barrier output stays {0,1} and the
+                  transpose bitcasts, H-layout predicts ~= barrier_mat.
+
+Run on the real chip: ``python scripts/gather_materialize_probe.py``.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/tpuminter-jax-cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+B = 16384
+N = 1024
+STEPS = N
+UNROLL = 2
+
+
+def sync(x):
+    np.asarray(jax.tree.leaves(x)[0])
+
+
+def timed(fn, *args, reps=3):
+    out = fn(*args)
+    sync(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 2**32, (B, 32), dtype=np.uint32))
+
+    @jax.jit
+    def make_v():
+        i = jnp.arange(N * B, dtype=jnp.uint32)[:, None]
+        j = jnp.arange(32, dtype=jnp.uint32)[None, :]
+        h = i * np.uint32(2654435761) + j * np.uint32(0x9E3779B9)
+        h ^= h >> 16
+        h *= np.uint32(0x85EBCA6B)
+        h ^= h >> 13
+        return h
+
+    vflat = make_v()
+    sync(vflat)
+    lane = jnp.arange(B, dtype=jnp.uint32)
+
+    def scan32(body):
+        @jax.jit
+        def run(x, v):
+            words = tuple(x[:, i] for i in range(32))
+
+            def step(carry, _):
+                return body(carry, v), None
+
+            words, _ = jax.lax.scan(step, words, None, length=STEPS,
+                                    unroll=UNROLL)
+            return words[0]
+
+        return run
+
+    def gather(v, carry):
+        j = carry[16] & np.uint32(N - 1)
+        return v[(j * np.uint32(B) + lane).astype(jnp.int32)]
+
+    def fold(carry, s):
+        out = list(carry)
+        out[16] = out[16] ^ s
+        return tuple(out)
+
+    def body_fused_reduce(carry, v):
+        vj = gather(v, carry)
+        return fold(carry, vj.sum(axis=1, dtype=jnp.uint32))
+
+    def body_barrier_mat(carry, v):
+        vj = gather(v, carry)
+        vj = jax.lax.optimization_barrier(vj)
+        return fold(carry, vj.sum(axis=1, dtype=jnp.uint32))
+
+    def body_barrier_tr(carry, v):
+        vj = gather(v, carry)
+        vj = jax.lax.optimization_barrier(vj)
+        vjt = jnp.transpose(vj).reshape(32, B // 128, 128)
+        return fold(carry, vjt[16].reshape(B))
+
+    for name, body in [
+        ("fused_reduce", body_fused_reduce),
+        ("barrier_mat", body_barrier_mat),
+        ("barrier_tr", body_barrier_tr),
+    ]:
+        try:
+            t = timed(scan32(body), x, vflat) / STEPS
+            print(f"{name:14s} {t * 1e6:8.1f} us/step")
+        except Exception as e:  # noqa: BLE001
+            print(f"{name:14s} FAILED: {type(e).__name__}: {str(e)[:160]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""The outer graph is byte-clean (walknt.hlo) yet the step still costs
+~690 us.  Last suspects: (a) the 32 in-kernel sublane extracts
+``vjg_ref[g, :, s, :]`` lower as Mosaic relayouts (~17 us each), or
+(b) the gather fusion / custom-call machinery itself.
+
+Same outer scan as walk_native_tile_probe, kernel body varies:
+
+  one_extract  — out[w] = xw[w] ^ vjg[0,:,0,:] (single sublane extract,
+                 32 dense xors).  Fast => extracts are the cost.
+  all_extracts — out[w] = xw[w] ^ vjg[g,:,s,:] (32 extracts, no salsa).
+  extracts_salsa — full body (baseline ~690).
+  null_kernel  — out[w] = xw[w] (vjg still an operand, never read).
+                 Fast => custom-call machinery fine, gather fine.
+
+Run on the real chip: ``python scripts/kernel_body_probe.py``.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/tpuminter-jax-cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from tpuminter.ops.scrypt import _block_mix_words  # noqa: E402
+
+B = 16384
+N = 1024
+LANES = 128
+ROWS = B // LANES
+BLOCK_RB = 16
+STEPS = N
+UNROLL = 2
+
+
+def sync(x):
+    np.asarray(jax.tree.leaves(x)[0])
+
+
+def timed(fn, *args, reps=3):
+    out = fn(*args)
+    sync(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def k_one_extract(xw_ref, vjg_ref, out_ref):
+    p = vjg_ref[0, :, 0, :]
+    for w in range(32):
+        out_ref[w] = xw_ref[w] ^ p
+
+
+def k_all_extracts(xw_ref, vjg_ref, out_ref):
+    for w in range(32):
+        g, s = divmod(w, 8)
+        out_ref[w] = xw_ref[w] ^ vjg_ref[g, :, s, :]
+
+
+def k_extracts_salsa(xw_ref, vjg_ref, out_ref):
+    words = []
+    for w in range(32):
+        g, s = divmod(w, 8)
+        words.append(xw_ref[w] ^ vjg_ref[g, :, s, :])
+    mixed = _block_mix_words(words)
+    for w in range(32):
+        out_ref[w] = mixed[w]
+
+
+def k_null(xw_ref, vjg_ref, out_ref):
+    for w in range(32):
+        out_ref[w] = xw_ref[w] ^ np.uint32(1)
+
+
+def make_call(kernel):
+    wm = pl.BlockSpec((32, BLOCK_RB, LANES), lambda i: (0, i, 0),
+                      memory_space=pltpu.VMEM)
+    gr = pl.BlockSpec((4, BLOCK_RB, 8, LANES), lambda i: (0, i, 0, 0),
+                      memory_space=pltpu.VMEM)
+
+    def call(xw, vjg):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((32, ROWS, LANES), jnp.uint32),
+            grid=(ROWS // BLOCK_RB,),
+            in_specs=[wm, gr],
+            out_specs=wm,
+        )(xw, vjg)
+
+    return call
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 2**32, (B, 32), dtype=np.uint32))
+
+    @jax.jit
+    def make_v():
+        i = jnp.arange(N * B, dtype=jnp.uint32)[:, None]
+        j = jnp.arange(32, dtype=jnp.uint32)[None, :]
+        h = i * np.uint32(2654435761) + j * np.uint32(0x9E3779B9)
+        h ^= h >> 16
+        h *= np.uint32(0x85EBCA6B)
+        h ^= h >> 13
+        return h
+
+    vflat = make_v()
+    sync(vflat)
+    lane = jnp.arange(B, dtype=jnp.uint32)
+
+    def scan_with(call):
+        @jax.jit
+        def run(x, v):
+            xw = jnp.transpose(x).reshape(32, ROWS, LANES)
+
+            def body(carry, _):
+                j = carry[16].reshape(B) & np.uint32(N - 1)
+                vj = v[(j * np.uint32(B) + lane).astype(jnp.int32)]
+                vjg = jnp.transpose(
+                    jnp.transpose(vj).reshape(4, 8, ROWS, LANES),
+                    (0, 2, 1, 3))
+                return call(carry, vjg), None
+
+            xw, _ = jax.lax.scan(body, xw, None, length=STEPS, unroll=UNROLL)
+            return xw[0, 0]
+
+        return run
+
+    for name, kern in [
+        ("null_kernel", k_null),
+        ("one_extract", k_one_extract),
+        ("all_extracts", k_all_extracts),
+        ("extracts_salsa", k_extracts_salsa),
+    ]:
+        try:
+            t = timed(scan_with(make_call(kern)), x, vflat) / STEPS
+            print(f"{name:15s} {t * 1e6:8.1f} us/step")
+        except Exception as e:  # noqa: BLE001
+            print(f"{name:15s} FAILED: {type(e).__name__}: {str(e)[:160]}")
+
+
+if __name__ == "__main__":
+    main()

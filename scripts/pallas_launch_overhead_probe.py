@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Pin down the per-pallas-call overhead inside lax.scan on this backend.
+
+transpose_micro_probe measured ~1.54 ms/call for EVERY kernel variant —
+including a plain dense 2 MB copy (should be ~5 us at HBM rates) — with
+the transpose itself free.  That smells like a fixed per-launch cost.
+This probe varies the knobs that distinguish the candidate causes:
+
+  xla_xor          — scan body is pure-XLA (c ^ 1) on the same 2 MB:
+                     the known ~90 us/iter axon scan floor (control).
+  grid8 / grid1    — dense 2 MB copy kernel with an 8-step vs 1-step
+                     grid: is the cost per grid step or per launch?
+  tiny             — (8,128) 4 KiB copy kernel: is it size-dependent?
+  grid1_reps16/128 — REPS scaling at fixed variant: confirms the
+                     per-call (not per-run) attribution.
+
+Run on the real chip: ``python scripts/pallas_launch_overhead_probe.py``.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/tpuminter-jax-cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+B = 16384
+LANES = 128
+
+
+def sync(x):
+    np.asarray(jax.tree.leaves(x)[0])
+
+
+def run_case(name, step, x, reps_lo=512, reps_hi=2048):
+    """Slope timing: t(reps_hi) - t(reps_lo) over the rep delta, so the
+    ~100 ms tunnel dispatch RTT (which swamped the first two probes'
+    small-rep totals) cancels exactly."""
+
+    def make(reps):
+        @jax.jit
+        def run(x):
+            def body(c, _):
+                return step(c), None
+
+            c, _ = jax.lax.scan(body, x, None, length=reps)
+            return c.sum(dtype=jnp.uint32)
+
+        return run
+
+    times = {}
+    for reps in (reps_lo, reps_hi):
+        run = make(reps)
+        sync(run(x))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            sync(run(x))
+            best = min(best, time.perf_counter() - t0)
+        times[reps] = best
+    slope = (times[reps_hi] - times[reps_lo]) / (reps_hi - reps_lo)
+    print(f"{name:16s} {slope * 1e6:9.1f} us/call  "
+          f"(totals {times[reps_lo] * 1e3:.0f} / {times[reps_hi] * 1e3:.0f} ms)")
+
+
+def _xor_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] ^ np.uint32(1)
+
+
+def copy_grid(n_grid):
+    rows = B // 4
+
+    def step(c):
+        return pl.pallas_call(
+            _xor_kernel,
+            out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.uint32),
+            grid=(n_grid,),
+            in_specs=[
+                pl.BlockSpec((rows // n_grid, LANES), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+            ],
+            out_specs=pl.BlockSpec((rows // n_grid, LANES), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+        )(c)
+
+    return step
+
+
+def tiny_step(c):
+    return pl.pallas_call(
+        _xor_kernel,
+        out_shape=jax.ShapeDtypeStruct((8, LANES), jnp.uint32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    )(c)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    big = jnp.asarray(rng.integers(0, 2**32, (B // 4, LANES), dtype=np.uint32))
+    small = jnp.asarray(rng.integers(0, 2**32, (8, LANES), dtype=np.uint32))
+
+    run_case("xla_xor", lambda c: c ^ np.uint32(1), big)
+    run_case("grid8", copy_grid(8), big)
+    run_case("grid1", copy_grid(1), big)
+    run_case("tiny", tiny_step, small)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Where do 325 ms go? Decompose the Pallas transpose kernel's cost.
+
+walk_pallas_probe stage 1 measured the (16384,32)->(32,16384) u32
+transpose kernel at ~325 ms warm — 150x over the ~2 MB HBM floor.  Two
+suspects: (a) the lane-padded (block_b, 32) input block (minor dim 32
+of 128 lanes -> strided/packed DMA), (b) Mosaic's jnp.transpose
+lowering itself.  Each variant below isolates one; all are timed as a
+K-rep in-jit scan (carry-chained through the kernel so nothing hoists)
+so the ~100 ms tunnel RTT amortizes away.
+
+Variants:
+  copy_padded     — (block_b,32) block in, (block_b,32) out; xor carry.
+                    Measures the padded-block DMA + launch floor.
+  copy_dense      — same data bitcast to (B/4,128) dense blocks.
+                    Measures the unpadded floor.
+  transpose_pad   — (block_b,32) in, transpose, (32,sub,128) out.
+                    The walk kernel's relayout as probed in stage 1.
+  transpose_dense — (B/4,128) bitcast in, (128,B/4) transposed out
+                    (full 128x128-tile transposes, no padding).
+
+Run on the real chip: ``python scripts/transpose_micro_probe.py``.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/tpuminter-jax-cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+B = 16384
+LANES = 128
+BLOCK_B = 2048
+SUB_B = BLOCK_B // LANES
+REPS = 64
+
+
+def sync(x):
+    np.asarray(jax.tree.leaves(x)[0])
+
+
+def timed(fn, *args, reps=3):
+    out = fn(*args)
+    sync(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best / REPS
+
+
+def scan_reps(step):
+    """Chain `step` REPS times through the carry inside one jit."""
+
+    @jax.jit
+    def run(x):
+        def body(c, _):
+            return step(c), None
+
+        c, _ = jax.lax.scan(body, x, None, length=REPS)
+        # sync on a scalar: pulling the 2 MB carry through the ~10-20
+        # MB/s tunnel would dominate the measurement (first probe's bug)
+        return c.sum(dtype=jnp.uint32)
+
+    return run
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x_np = rng.integers(0, 2**32, (B, 32), dtype=np.uint32)
+
+    # ---- copy_padded: (block_b, 32) blocks, carry-chained xor ----
+    def _copy_pad_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] ^ np.uint32(1)
+
+    def copy_padded(x):
+        return pl.pallas_call(
+            _copy_pad_kernel,
+            out_shape=jax.ShapeDtypeStruct((B, 32), jnp.uint32),
+            grid=(B // BLOCK_B,),
+            in_specs=[
+                pl.BlockSpec((BLOCK_B, 32), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+            ],
+            out_specs=pl.BlockSpec((BLOCK_B, 32), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+        )(x)
+
+    # ---- copy_dense: same bytes as (B/4, 128) blocks ----
+    def copy_dense(x):
+        xd = x.reshape(B // 4, LANES)
+        out = pl.pallas_call(
+            _copy_pad_kernel,
+            out_shape=jax.ShapeDtypeStruct((B // 4, LANES), jnp.uint32),
+            grid=(B // BLOCK_B,),
+            in_specs=[
+                pl.BlockSpec((BLOCK_B // 4, LANES), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+            ],
+            out_specs=pl.BlockSpec((BLOCK_B // 4, LANES), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+        )(xd)
+        return out.reshape(B, 32)
+
+    # ---- transpose_pad: stage-1 kernel, carry-chained via transpose back
+    # in XLA would re-measure the strided unpack, so chain on a word slice:
+    # out word-major -> feed next rep by bitcasting (free reshape) ----
+    def _tr_pad_kernel(x_ref, o_ref):
+        o_ref[...] = jnp.transpose(x_ref[...]).reshape(32, SUB_B, LANES)
+
+    def transpose_pad(x):
+        out = pl.pallas_call(
+            _tr_pad_kernel,
+            out_shape=jax.ShapeDtypeStruct((32, B // LANES, LANES), jnp.uint32),
+            grid=(B // BLOCK_B,),
+            in_specs=[
+                pl.BlockSpec((BLOCK_B, 32), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+            ],
+            out_specs=pl.BlockSpec((32, SUB_B, LANES), lambda i: (0, i, 0),
+                                   memory_space=pltpu.VMEM),
+        )(x)
+        # free relayout back to (B, 32) shape for the next rep: NOT a
+        # mathematical inverse, but keeps bytes flowing through the kernel
+        return out.reshape(B, 32)
+
+    # ---- transpose_dense: full-tile (B/4,128) -> (128,B/4) ----
+    def _tr_dense_kernel(x_ref, o_ref):
+        o_ref[...] = jnp.transpose(x_ref[...])
+
+    def transpose_dense(x):
+        xd = x.reshape(B // 4, LANES)
+        out = pl.pallas_call(
+            _tr_dense_kernel,
+            out_shape=jax.ShapeDtypeStruct((LANES, B // 4), jnp.uint32),
+            grid=(B // BLOCK_B,),
+            in_specs=[
+                pl.BlockSpec((BLOCK_B // 4, LANES), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+            ],
+            out_specs=pl.BlockSpec((LANES, BLOCK_B // 4), lambda i: (0, i),
+                                   memory_space=pltpu.VMEM),
+        )(xd)
+        return out.reshape(B, 32)
+
+    x = jnp.asarray(x_np)
+    for name, step in [
+        ("copy_padded", copy_padded),
+        ("copy_dense", copy_dense),
+        ("transpose_pad", transpose_pad),
+        ("transpose_dense", transpose_dense),
+    ]:
+        try:
+            t0 = time.perf_counter()
+            fn = scan_reps(step)
+            out = fn(x)
+            sync(out)
+            compile_s = time.perf_counter() - t0
+            t = timed(fn, x)
+            print(f"{name:16s} {t * 1e6:9.1f} us/call "
+                  f"({2 * B * 32 * 4 / t / 1e9:6.1f} GB/s r+w, "
+                  f"compile {compile_s:.0f}s)")
+        except Exception as e:  # noqa: BLE001 — print and keep probing
+            print(f"{name:16s} FAILED: {type(e).__name__}: {e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Round-5 scrypt lever, take 5: ONE plane-major element gather.
+
+kernel_body_probe closed the pallas route: a null kernel that never
+reads the gathered operand still costs 676 us/step — the gather
+fusion's materialization AS A CUSTOM-CALL OPERAND is the expense, and
+XLA-side plane extraction (all_planes) costs the same 550 us.  The
+fast consumers are the ones that FUSE into the gather emitter.
+
+So: make the gather itself produce the planes.  Store V plane-major
+per step — the fill scan's ys stacked as (N, 32, B), flat view
+(N*32*B,) — and fetch all 32 planes with ONE element gather:
+
+    idx[w, b] = j[b]*32*B + w*B + b        # (32, B) int32
+    planes    = V1d[idx]                   # one gather op
+
+Each output plane is then a contiguous slice (free extracts), writes
+are linear, and the only cost over the row-gather is HBM burst
+amplification on 4-byte random reads (32 B bursts -> ~8x of 2 MB =
+~16 MB/step).  Variants:
+
+  walk_ref  — shipping row-gather body (baseline ~670).
+  walk_eg   — element-gather walk, xor+salsa on (B,) words (pure XLA).
+
+Both bit-checked against each other over 4 chained steps first.
+
+Run on the real chip: ``python scripts/walk_element_gather_probe.py``.
+"""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/tpuminter-jax-cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from tpuminter.ops.scrypt import _block_mix_words  # noqa: E402
+
+B = 16384
+N = 1024
+STEPS = N
+UNROLL = 2
+
+
+def sync(x):
+    np.asarray(jax.tree.leaves(x)[0])
+
+
+def timed(fn, *args, reps=3):
+    out = fn(*args)
+    sync(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x_np = rng.integers(0, 2**32, (B, 32), dtype=np.uint32)
+    x = jnp.asarray(x_np)
+
+    @jax.jit
+    def make_v_rows():
+        i = jnp.arange(N * B, dtype=jnp.uint32)[:, None]
+        j = jnp.arange(32, dtype=jnp.uint32)[None, :]
+        h = i * np.uint32(2654435761) + j * np.uint32(0x9E3779B9)
+        h ^= h >> 16
+        h *= np.uint32(0x85EBCA6B)
+        h ^= h >> 13
+        return h
+
+    vrows = make_v_rows()           # (N*B, 32) row-major semantics
+    sync(vrows)
+
+    @jax.jit
+    def to_plane_major(vr):
+        # (N*B, 32) -> (N, B, 32) -> (N, 32, B) -> flat (N*32*B,)
+        return jnp.transpose(vr.reshape(N, B, 32), (0, 2, 1)).reshape(-1)
+
+    v1d = to_plane_major(vrows)     # same data, plane-major per step
+    sync(v1d)
+    lane = jnp.arange(B, dtype=jnp.uint32)
+    word_off = (jnp.arange(32, dtype=jnp.uint32) * np.uint32(B))[:, None]
+
+    def eg_body(carry, v1):
+        j = carry[16] & np.uint32(N - 1)
+        base = (j * np.uint32(32 * B) + lane)[None, :]       # (1, B)
+        planes = v1[(base + word_off).astype(jnp.int32)]      # (32, B)
+        mixed = [c ^ planes[i] for i, c in enumerate(carry)]
+        return tuple(_block_mix_words(mixed))
+
+    def ref_body(carry, vr):
+        j = carry[16] & np.uint32(N - 1)
+        vj = vr[(j * np.uint32(B) + lane).astype(jnp.int32)]
+        return tuple(_block_mix_words(
+            [c ^ vj[:, i] for i, c in enumerate(carry)]))
+
+    # ---- bit-exactness: 4 chained steps, both bodies ----
+    @partial(jax.jit, static_argnums=(2,))
+    def chain(x, v, body_name):
+        words = tuple(x[:, i] for i in range(32))
+        body = {"eg": eg_body, "ref": ref_body}[body_name]
+        for _ in range(4):
+            words = body(words, v)
+        return jnp.stack(words, axis=-1)
+
+    ref = np.asarray(chain(x, vrows, "ref"))
+    got = np.asarray(chain(x, v1d, "eg"))
+    exact = bool((ref == got).all())
+    print(f"stage1 element-gather 4-step chain: exact={exact}")
+    if not exact:
+        raise SystemExit("element-gather body wrong — stop here")
+
+    # ---- 1024-step scans ----
+    def scan(body):
+        @jax.jit
+        def run(x, v):
+            words = tuple(x[:, i] for i in range(32))
+
+            def step(carry, _):
+                return body(carry, v), None
+
+            words, _ = jax.lax.scan(step, words, None, length=STEPS,
+                                    unroll=UNROLL)
+            return words[0]
+
+        return run
+
+    t_ref = timed(scan(ref_body), x, vrows) / STEPS
+    t_eg = timed(scan(eg_body), x, v1d) / STEPS
+    print(f"stage2 walk scan: shipping {t_ref * 1e6:8.1f} us/step")
+    print(f"                  eg       {t_eg * 1e6:8.1f} us/step "
+          f"({t_ref / t_eg:.2f}x)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Isolate WHICH consumer of the word-major gather bytes costs 550 us.
+
+Facts so far (round-5 probes):
+- gather + barrier + transpose/reshape + read ONE word plane = 86 us
+  (gather_materialize_probe barrier_tr) — materializing the gather's
+  word-major bytes is free;
+- every FULL walk variant (XLA extracts, pallas with in-kernel
+  transpose, pallas on byte-clean word-major operands) = 650-690 us.
+
+Somewhere between "read one plane" and "full body" sits a ~550 us op.
+Incremental scans (1024 steps, us/step):
+
+  one_plane   — barrier_tr reproduction (baseline, ~86).
+  all_planes  — xor-fold ALL 32 planes into the carry words; no salsa.
+  plus_salsa  — all_planes + BlockMix (the full pure-XLA word-major
+                walk body).
+  pallas_xs   — barrier-pinned word-major bytes -> pallas xor+salsa
+                kernel (the take-2 design on the proven-cheap bytes).
+
+Run on the real chip: ``python scripts/walk_isolate_probe.py``.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/tpuminter-jax-cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from tpuminter.ops.scrypt import _block_mix_words  # noqa: E402
+
+B = 16384
+N = 1024
+LANES = 128
+ROWS = B // LANES
+BLOCK_B = 2048
+SUB = BLOCK_B // LANES
+STEPS = N
+UNROLL = 2
+
+
+def sync(x):
+    np.asarray(jax.tree.leaves(x)[0])
+
+
+def timed(fn, *args, reps=3):
+    out = fn(*args)
+    sync(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _xs_kernel(xw_ref, vj_ref, out_ref):
+    words = [xw_ref[i] ^ vj_ref[i] for i in range(32)]
+    mixed = _block_mix_words(words)
+    for i in range(32):
+        out_ref[i] = mixed[i]
+
+
+def fused_xor_salsa(xw, vjt):
+    spec = pl.BlockSpec((32, SUB, LANES), lambda i: (0, i, 0),
+                        memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _xs_kernel,
+        out_shape=jax.ShapeDtypeStruct((32, ROWS, LANES), jnp.uint32),
+        grid=(B // BLOCK_B,),
+        in_specs=[spec, spec],
+        out_specs=spec,
+    )(xw, vjt)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 2**32, (B, 32), dtype=np.uint32))
+
+    @jax.jit
+    def make_v():
+        i = jnp.arange(N * B, dtype=jnp.uint32)[:, None]
+        j = jnp.arange(32, dtype=jnp.uint32)[None, :]
+        h = i * np.uint32(2654435761) + j * np.uint32(0x9E3779B9)
+        h ^= h >> 16
+        h *= np.uint32(0x85EBCA6B)
+        h ^= h >> 13
+        return h
+
+    vflat = make_v()
+    sync(vflat)
+    lane = jnp.arange(B, dtype=jnp.uint32)
+
+    def scan32(body):
+        @jax.jit
+        def run(x, v):
+            words = tuple(x[:, i] for i in range(32))
+
+            def step(carry, _):
+                return body(carry, v), None
+
+            words, _ = jax.lax.scan(step, words, None, length=STEPS,
+                                    unroll=UNROLL)
+            return words[0]
+
+        return run
+
+    def gather_wm(v, carry):
+        j = carry[16] & np.uint32(N - 1)
+        vj = v[(j * np.uint32(B) + lane).astype(jnp.int32)]
+        vj = jax.lax.optimization_barrier(vj)
+        return jnp.transpose(vj).reshape(32, ROWS, LANES)
+
+    def body_one_plane(carry, v):
+        vjt = gather_wm(v, carry)
+        out = list(carry)
+        out[16] = out[16] ^ vjt[16].reshape(B)
+        return tuple(out)
+
+    def body_all_planes(carry, v):
+        vjt = gather_wm(v, carry)
+        return tuple(c ^ vjt[i].reshape(B) for i, c in enumerate(carry))
+
+    def body_plus_salsa(carry, v):
+        vjt = gather_wm(v, carry)
+        mixed = [c ^ vjt[i].reshape(B) for i, c in enumerate(carry)]
+        return tuple(_block_mix_words(mixed))
+
+    def scan_pallas():
+        @jax.jit
+        def run(x, v):
+            xw = jnp.transpose(x).reshape(32, ROWS, LANES)
+
+            def step(carry, _):
+                vjt = gather_wm(v, [carry[16].reshape(B)] * 17)
+                return fused_xor_salsa(carry, vjt), None
+
+            xw, _ = jax.lax.scan(step, xw, None, length=STEPS, unroll=UNROLL)
+            return xw[0, 0]
+
+        return run
+
+    cases = [
+        ("one_plane", scan32(body_one_plane)),
+        ("all_planes", scan32(body_all_planes)),
+        ("plus_salsa", scan32(body_plus_salsa)),
+        ("pallas_xs", scan_pallas()),
+    ]
+    for name, fn in cases:
+        try:
+            t = timed(fn, x, vflat) / STEPS
+            print(f"{name:12s} {t * 1e6:8.1f} us/step")
+        except Exception as e:  # noqa: BLE001
+            print(f"{name:12s} FAILED: {type(e).__name__}: {str(e)[:160]}")
+
+
+if __name__ == "__main__":
+    main()

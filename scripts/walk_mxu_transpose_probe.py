@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Round-5 scrypt lever, take 6: transpose the gathered rows on the MXU.
+
+Every data-movement spelling of the (B,32)->32x(B,) unpack costs
+~550 us/step on this toolchain (takes 1-5: XLA extracts, pallas
+operands in any byte layout including the gather's native tiles — even
+a null kernel, and a plane-major element gather at 7 ms).  The one
+engine not yet tried: the MXU.  Transposition is a matmul with the
+identity —
+
+    planes_f32 = dot(I_32, vj_f32, contract dim1 x dim1) -> (32, B)
+
+u32 words split into two 16-bit halves (exact in f32: each partial
+product has ONE nonzero term), transposed as two dots, recombined with
+a shift+or.  16.7M MACs per half = ~1 us of MXU time; converts are
+elementwise (fusible into the gather); dot output layouts are the
+compiler's happy path.
+
+Variants (1024-step scans, us/step):
+  walk_ref — shipping body (~670 baseline)
+  walk_mxu — gather -> split/convert -> 2 identity dots -> recombine ->
+             xor + BlockMix on dense (B,) plane vectors
+
+Bit-exactness checked over 4 chained steps first.
+
+Run on the real chip: ``python scripts/walk_mxu_transpose_probe.py``.
+"""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/tpuminter-jax-cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from tpuminter.ops.scrypt import _block_mix_words  # noqa: E402
+
+B = 16384
+N = 1024
+STEPS = N
+UNROLL = 2
+
+_DOT_DN = (((1,), (1,)), ((), ()))  # contract dim1 x dim1, no batch
+
+
+def sync(x):
+    np.asarray(jax.tree.leaves(x)[0])
+
+
+def timed(fn, *args, reps=3):
+    out = fn(*args)
+    sync(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def mxu_transpose_u32(vj, eye32):
+    """(B, 32) u32 -> (32, B) u32 via two exact f32 identity dots."""
+    lo = (vj & np.uint32(0xFFFF)).astype(jnp.float32)
+    hi = (vj >> np.uint32(16)).astype(jnp.float32)
+    # HIGHEST: the MXU's default bf16 input truncation (8-bit mantissa)
+    # mangles 16-bit chunks; the 3-pass decomposition is exact here
+    lo_t = jax.lax.dot_general(eye32, lo, _DOT_DN,
+                               precision=jax.lax.Precision.HIGHEST,
+                               preferred_element_type=jnp.float32)
+    hi_t = jax.lax.dot_general(eye32, hi, _DOT_DN,
+                               precision=jax.lax.Precision.HIGHEST,
+                               preferred_element_type=jnp.float32)
+    return (hi_t.astype(jnp.uint32) << np.uint32(16)) | lo_t.astype(jnp.uint32)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x_np = rng.integers(0, 2**32, (B, 32), dtype=np.uint32)
+    x = jnp.asarray(x_np)
+    eye32 = jnp.eye(32, dtype=jnp.float32)
+
+    @jax.jit
+    def make_v():
+        i = jnp.arange(N * B, dtype=jnp.uint32)[:, None]
+        j = jnp.arange(32, dtype=jnp.uint32)[None, :]
+        h = i * np.uint32(2654435761) + j * np.uint32(0x9E3779B9)
+        h ^= h >> 16
+        h *= np.uint32(0x85EBCA6B)
+        h ^= h >> 13
+        return h
+
+    vflat = make_v()
+    sync(vflat)
+    lane = jnp.arange(B, dtype=jnp.uint32)
+
+    def mxu_body(carry, v):
+        j = carry[16] & np.uint32(N - 1)
+        vj = v[(j * np.uint32(B) + lane).astype(jnp.int32)]
+        planes = mxu_transpose_u32(vj, eye32)       # (32, B)
+        mixed = [c ^ planes[i] for i, c in enumerate(carry)]
+        return tuple(_block_mix_words(mixed))
+
+    def ref_body(carry, v):
+        j = carry[16] & np.uint32(N - 1)
+        vj = v[(j * np.uint32(B) + lane).astype(jnp.int32)]
+        return tuple(_block_mix_words(
+            [c ^ vj[:, i] for i, c in enumerate(carry)]))
+
+    @partial(jax.jit, static_argnums=(2,))
+    def chain(x, v, which):
+        words = tuple(x[:, i] for i in range(32))
+        body = {"mxu": mxu_body, "ref": ref_body}[which]
+        for _ in range(4):
+            words = body(words, v)
+        return jnp.stack(words, axis=-1)
+
+    ref = np.asarray(chain(x, vflat, "ref"))
+    got = np.asarray(chain(x, vflat, "mxu"))
+    exact = bool((ref == got).all())
+    print(f"stage1 mxu-transpose 4-step chain: exact={exact}")
+    if not exact:
+        bad = np.argwhere(ref != got)
+        print(f"  first mismatches: {bad[:5]}")
+        raise SystemExit("mxu body wrong — stop here")
+
+    def scan(body):
+        @jax.jit
+        def run(x, v):
+            words = tuple(x[:, i] for i in range(32))
+
+            def step(carry, _):
+                return body(carry, v), None
+
+            words, _ = jax.lax.scan(step, words, None, length=STEPS,
+                                    unroll=UNROLL)
+            return words[0]
+
+        return run
+
+    t_ref = timed(scan(ref_body), x, vflat) / STEPS
+    t_mxu = timed(scan(mxu_body), x, vflat) / STEPS
+    print(f"stage2 walk scan: shipping {t_ref * 1e6:8.1f} us/step")
+    print(f"                  mxu      {t_mxu * 1e6:8.1f} us/step "
+          f"({t_ref / t_mxu:.2f}x)")
+
+
+if __name__ == "__main__":
+    main()

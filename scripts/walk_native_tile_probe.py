@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Round-5 scrypt lever, take 4: consume the gather's NATIVE TILES.
+
+The all_planes HLO (walk_isolate_probe + /tmp/allplanes.hlo) finally
+named the 550 us/step: ONE op, ``copy(4,8,128,128){3,1,2,0}->
+{3,2,1,0}`` — a 2 MB sublane re-tiling.  The TPU gather emitter's
+native output interleaves each 8-word group across SUBLANES: bytes are
+ordered [word_group(4), row_block(128), word_in_group(8), lane(128)].
+Every previous probe demanded plane-contiguous or row-contiguous bytes
+and paid the re-tiling; this take demands the NATIVE bytes:
+
+  vjg = vj.T.reshape(4, 8, 128, 128).transpose(0, 2, 1, 3)
+
+whose result (4,128,8,128) in DEFAULT layout is byte-identical to the
+gather's native output — the whole chain is bitcasts.  The pallas
+kernel extracts word planes as ``vjg_ref[g, :, s, :]`` — sublane
+slices, single-vreg ops in VMEM — then xor + BlockMix on dense planes.
+
+Stages: 1. bit-exactness (4 chained steps); 2. 1024-step walk timing.
+
+Run on the real chip: ``python scripts/walk_native_tile_probe.py``.
+"""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/tpuminter-jax-cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from tpuminter.ops.scrypt import _block_mix_words  # noqa: E402
+
+B = 16384
+N = 1024
+LANES = 128
+ROWS = B // LANES            # 128 row blocks
+BLOCK_RB = 16                # row blocks per grid step (2048 rows)
+STEPS = N
+UNROLL = 2
+
+
+def sync(x):
+    np.asarray(jax.tree.leaves(x)[0])
+
+
+def timed(fn, *args, reps=3):
+    out = fn(*args)
+    sync(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _xs_kernel(xw_ref, vjg_ref, out_ref):
+    words = []
+    for w in range(32):
+        g, s = divmod(w, 8)
+        words.append(xw_ref[w] ^ vjg_ref[g, :, s, :])
+    mixed = _block_mix_words(words)
+    for w in range(32):
+        out_ref[w] = mixed[w]
+
+
+def fused_xor_salsa(xw, vjg):
+    wm = pl.BlockSpec((32, BLOCK_RB, LANES), lambda i: (0, i, 0),
+                      memory_space=pltpu.VMEM)
+    gr = pl.BlockSpec((4, BLOCK_RB, 8, LANES), lambda i: (0, i, 0, 0),
+                      memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _xs_kernel,
+        out_shape=jax.ShapeDtypeStruct((32, ROWS, LANES), jnp.uint32),
+        grid=(ROWS // BLOCK_RB,),
+        in_specs=[wm, gr],
+        out_specs=wm,
+    )(xw, vjg)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x_np = rng.integers(0, 2**32, (B, 32), dtype=np.uint32)
+    x = jnp.asarray(x_np)
+
+    @jax.jit
+    def make_v():
+        i = jnp.arange(N * B, dtype=jnp.uint32)[:, None]
+        j = jnp.arange(32, dtype=jnp.uint32)[None, :]
+        h = i * np.uint32(2654435761) + j * np.uint32(0x9E3779B9)
+        h ^= h >> 16
+        h *= np.uint32(0x85EBCA6B)
+        h ^= h >> 13
+        return h
+
+    vflat = make_v()
+    sync(vflat)
+    lane = jnp.arange(B, dtype=jnp.uint32)
+
+    def wm_body(carry, v):
+        j = carry[16].reshape(B) & np.uint32(N - 1)
+        vj = v[(j * np.uint32(B) + lane).astype(jnp.int32)]
+        vjg = jnp.transpose(
+            jnp.transpose(vj).reshape(4, 8, ROWS, LANES), (0, 2, 1, 3))
+        return fused_xor_salsa(carry, vjg)
+
+    # ---- stage 1: bit-exactness over 4 chained steps ----
+    @partial(jax.jit, static_argnums=2)
+    def ref_steps(x, v, k):
+        words = tuple(x[:, i] for i in range(32))
+        for _ in range(k):
+            j = words[16] & np.uint32(N - 1)
+            vjk = v[(j * np.uint32(B) + lane).astype(jnp.int32)]
+            mixed = [c ^ vjk[:, i] for i, c in enumerate(words)]
+            words = tuple(_block_mix_words(mixed))
+        return jnp.stack(words, axis=-1)
+
+    @partial(jax.jit, static_argnums=2)
+    def fused_steps(x, v, k):
+        xw = jnp.transpose(x).reshape(32, ROWS, LANES)
+        for _ in range(k):
+            xw = wm_body(xw, v)
+        return jnp.transpose(xw.reshape(32, B))
+
+    ref = np.asarray(ref_steps(x, vflat, 4))
+    got = np.asarray(fused_steps(x, vflat, 4))
+    exact = bool((ref == got).all())
+    print(f"stage1 fused 4-step chain: exact={exact}")
+    if not exact:
+        bad = np.argwhere(ref != got)
+        print(f"  first mismatches (row, word): {bad[:5]}")
+        raise SystemExit("fused kernel wrong — stop here")
+
+    # ---- stage 2: 1024-step walk scan timing ----
+    @jax.jit
+    def walk_ref(x, v):
+        words = tuple(x[:, i] for i in range(32))
+
+        def body(carry, _):
+            j = carry[16] & np.uint32(N - 1)
+            vjk = v[(j * np.uint32(B) + lane).astype(jnp.int32)]
+            mixed = [c ^ vjk[:, i] for i, c in enumerate(carry)]
+            return tuple(_block_mix_words(mixed)), None
+
+        words, _ = jax.lax.scan(body, words, None, length=STEPS, unroll=UNROLL)
+        return words[0]
+
+    @jax.jit
+    def walk_fused(x, v):
+        xw = jnp.transpose(x).reshape(32, ROWS, LANES)
+
+        def body(carry, _):
+            return wm_body(carry, v), None
+
+        xw, _ = jax.lax.scan(body, xw, None, length=STEPS, unroll=UNROLL)
+        return xw[0, 0]
+
+    t_ref = timed(walk_ref, x, vflat) / STEPS
+    t_fused = timed(walk_fused, x, vflat) / STEPS
+    print(f"stage2 walk scan: shipping {t_ref * 1e6:8.1f} us/step")
+    print(f"                  fused    {t_fused * 1e6:8.1f} us/step "
+          f"({t_ref / t_fused:.2f}x)")
+
+
+if __name__ == "__main__":
+    main()

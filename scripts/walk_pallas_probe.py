@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Probe the round-5 scrypt lever: a Pallas kernel fusing the
+(B,32)->32x(B,) relayout + xor + salsa at VMEM rates.
+
+PERF.md's walk-step decomposition (round 4) shows the shipping walk
+step (680 us at B=16384) is 80% the strided unpack: XLA lowers each of
+the 32 ``vj[:, i]`` column extracts as a strided HBM pass (3.6 GB/s
+effective).  The fix under test: the gather stays in XLA (its row
+gather is near-free, 29 us), but the gathered ``(B, 32)`` rows are
+handed to a Mosaic kernel that transposes them in VMEM (verified
+bit-exact and cost-free relative to launch noise by
+transpose_micro_probe), xors with a word-major ``(32, B/128, 128)``
+carry, and runs BlockMix on dense full-vreg word planes.  HBM traffic
+per step drops to three linear passes.
+
+Measurement notes (hard-won, see pallas_launch_overhead_probe):
+- per-pallas-call overhead inside lax.scan is < ~25 us — invisible
+  under the 67-119 ms tunnel dispatch jitter, so only long scans with
+  real work (hundreds of ms totals) measure anything;
+- sync on SMALL outputs: pulling a 2 MB array back through the tunnel
+  costs ~200 ms and swamps everything;
+- V must be a jit ARGUMENT (a captured 2 GiB constant stalls lowering)
+  and must be GENERATED ON DEVICE: pushing 2 GiB through the ~5 MB/s
+  tunnel takes ~7 minutes.
+
+Stages:
+  1. fused walk-step kernel: bit-exactness vs the shipping jnp walk
+     body over a 4-step data-dependent chain (transpose + xor + salsa
+     + gather-index handoff all covered).
+  2. 1024-step walk scan: fused vs shipping, us/step.
+
+Run on the real chip: ``python scripts/walk_pallas_probe.py``.
+"""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/tpuminter-jax-cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from tpuminter.ops.scrypt import _block_mix_words  # noqa: E402
+
+B = 16384
+N = 1024
+LANES = 128
+BLOCK_B = 2048
+SUB_B = BLOCK_B // LANES
+UNROLL = 2
+STEPS = N
+
+
+def sync(x):
+    np.asarray(jax.tree.leaves(x)[0])
+
+
+def timed(fn, *args, reps=3):
+    out = fn(*args)
+    sync(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _wm_spec():
+    return pl.BlockSpec((32, SUB_B, LANES), lambda i: (0, i, 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _transpose_kernel(vj_ref, out_ref):
+    out_ref[...] = jnp.transpose(vj_ref[...]).reshape(32, SUB_B, LANES)
+
+
+@jax.jit
+def to_wm(x):
+    """(B, 32) row-major -> (32, B/128, 128) word-major, via Mosaic."""
+    return pl.pallas_call(
+        _transpose_kernel,
+        out_shape=jax.ShapeDtypeStruct((32, B // LANES, LANES), jnp.uint32),
+        grid=(B // BLOCK_B,),
+        in_specs=[
+            pl.BlockSpec((BLOCK_B, 32), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+        ],
+        out_specs=_wm_spec(),
+    )(x)
+
+
+def _walk_kernel(xw_ref, vj_ref, out_ref):
+    vjt = jnp.transpose(vj_ref[...]).reshape(32, SUB_B, LANES)
+    words = [xw_ref[i] ^ vjt[i] for i in range(32)]
+    mixed = _block_mix_words(words)
+    for i in range(32):
+        out_ref[i] = mixed[i]
+
+
+def fused_step(xw, vj):
+    return pl.pallas_call(
+        _walk_kernel,
+        out_shape=jax.ShapeDtypeStruct((32, B // LANES, LANES), jnp.uint32),
+        grid=(B // BLOCK_B,),
+        in_specs=[
+            _wm_spec(),
+            pl.BlockSpec((BLOCK_B, 32), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=_wm_spec(),
+    )(xw, vj)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x_np = rng.integers(0, 2**32, (B, 32), dtype=np.uint32)
+    x = jnp.asarray(x_np)
+
+    @jax.jit
+    def make_v():
+        # device-side pseudo-random V (values irrelevant — both paths
+        # read the SAME array); murmur-style integer mix of the index
+        i = jnp.arange(N * B, dtype=jnp.uint32)[:, None]
+        j = jnp.arange(32, dtype=jnp.uint32)[None, :]
+        h = i * np.uint32(2654435761) + j * np.uint32(0x9E3779B9)
+        h ^= h >> 16
+        h *= np.uint32(0x85EBCA6B)
+        h ^= h >> 13
+        return h
+
+    vflat = make_v()
+    sync(vflat)
+    lane = jnp.arange(B, dtype=jnp.uint32)
+
+    def gather(v, j):
+        return v[(j * np.uint32(B) + lane).astype(jnp.int32)]
+
+    # ---- stage 1: bit-exactness over a 4-step data-dependent chain ----
+    @partial(jax.jit, static_argnums=2)
+    def ref_steps(x, v, k):
+        words = tuple(x[:, i] for i in range(32))
+        for _ in range(k):
+            j = words[16] & np.uint32(N - 1)
+            vjk = gather(v, j)
+            mixed = [c ^ vjk[:, i] for i, c in enumerate(words)]
+            words = tuple(_block_mix_words(mixed))
+        return jnp.stack(words, axis=-1)
+
+    @partial(jax.jit, static_argnums=2)
+    def fused_steps(x, v, k):
+        xw = to_wm(x)
+        for _ in range(k):
+            j = xw[16].reshape(B) & np.uint32(N - 1)
+            xw = fused_step(xw, gather(v, j))
+        return jnp.transpose(xw.reshape(32, B))
+
+    ref = np.asarray(ref_steps(x, vflat, 4))
+    got = np.asarray(fused_steps(x, vflat, 4))
+    exact = bool((ref == got).all())
+    print(f"stage1 fused 4-step chain: exact={exact}")
+    if not exact:
+        bad = np.argwhere(ref != got)
+        print(f"  first mismatches (row, word): {bad[:5]}")
+        raise SystemExit("fused kernel wrong — stop here")
+
+    # ---- stage 2: 1024-step walk scan timing ----
+    @jax.jit
+    def walk_ref(x, v):
+        words = tuple(x[:, i] for i in range(32))
+
+        def body(carry, _):
+            j = carry[16] & np.uint32(N - 1)
+            vjk = gather(v, j)
+            mixed = [c ^ vjk[:, i] for i, c in enumerate(carry)]
+            return tuple(_block_mix_words(mixed)), None
+
+        words, _ = jax.lax.scan(body, words, None, length=STEPS, unroll=UNROLL)
+        return words[0]
+
+    @jax.jit
+    def walk_fused(x, v):
+        xw = to_wm(x)
+
+        def body(carry, _):
+            j = carry[16].reshape(B) & np.uint32(N - 1)
+            return fused_step(carry, gather(v, j)), None
+
+        xw, _ = jax.lax.scan(body, xw, None, length=STEPS, unroll=UNROLL)
+        return xw[0, 0]  # (128,): small pull
+
+    t_ref = timed(walk_ref, x, vflat) / STEPS
+    t_fused = timed(walk_fused, x, vflat) / STEPS
+    print(f"stage2 walk scan: shipping {t_ref * 1e6:8.1f} us/step")
+    print(f"                  fused    {t_fused * 1e6:8.1f} us/step "
+          f"({t_ref / t_fused:.2f}x)")
+
+
+if __name__ == "__main__":
+    main()

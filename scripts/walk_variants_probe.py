@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Decompose the fused walk kernel's 672 us/step (walk_pallas_probe):
+which in-kernel stage eats the time?
+
+Variants (all run as 1024-step scans with the real data-dependent
+gather chain, so totals are far above the 67-119 ms RTT jitter; all
+keep the carry dependent on vj so nothing hoists; "wrong math" variants
+still chain j through their output):
+
+  tr_only    — kernel writes transpose(vj) only (no xor/salsa):
+               isolates the padded-block (2048,32)->(32,2048) transpose.
+  tr_dense   — kernel transposes vj bitcast as (512,128) full tiles ->
+               (128,512) (Mosaic's optimal XLU path), xors into carry
+               rows: is full-tile transpose the fast alternative?
+  xor_only   — kernel xors carry with vj BITCAST to word-plane shape
+               (free relayout, wrong values): isolates IO + xor at
+               dense layouts, no transpose at all.
+  salsa_only — kernel runs BlockMix on the carry, vj folded in by one
+               dense xor on the packed shape: isolates in-kernel salsa.
+  full       — the walk_pallas_probe kernel (transpose + xor + salsa).
+  full_g1    — same but grid=1 (one 2 MB block): per-grid-step cost?
+
+Run on the real chip: ``python scripts/walk_variants_probe.py``.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/tpuminter-jax-cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from tpuminter.ops.scrypt import _block_mix_words  # noqa: E402
+
+B = 16384
+N = 1024
+LANES = 128
+STEPS = N
+UNROLL = 2
+
+
+def sync(x):
+    np.asarray(jax.tree.leaves(x)[0])
+
+
+def timed(fn, *args, reps=3):
+    out = fn(*args)
+    sync(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def wm_call(kernel, block_b, n_in=2):
+    """pallas_call over word-major carry (32, B/128, 128) + row-major
+    vj (B, 32) -> word-major out, grid along the batch."""
+    sub_b = block_b // LANES
+    specs = [
+        pl.BlockSpec((32, sub_b, LANES), lambda i: (0, i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((block_b, 32), lambda i: (i, 0),
+                     memory_space=pltpu.VMEM),
+    ][:n_in]
+
+    def call(*args):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((32, B // LANES, LANES),
+                                           jnp.uint32),
+            grid=(B // block_b,),
+            in_specs=specs,
+            out_specs=pl.BlockSpec((32, sub_b, LANES), lambda i: (0, i, 0),
+                                   memory_space=pltpu.VMEM),
+        )(*args)
+
+    return call
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 2**32, (B, 32), dtype=np.uint32))
+
+    @jax.jit
+    def make_v():
+        i = jnp.arange(N * B, dtype=jnp.uint32)[:, None]
+        j = jnp.arange(32, dtype=jnp.uint32)[None, :]
+        h = i * np.uint32(2654435761) + j * np.uint32(0x9E3779B9)
+        h ^= h >> 16
+        h *= np.uint32(0x85EBCA6B)
+        h ^= h >> 13
+        return h
+
+    vflat = make_v()
+    sync(vflat)
+    lane = jnp.arange(B, dtype=jnp.uint32)
+
+    BB = 2048
+    SUB = BB // LANES
+
+    # ---- kernels ----
+    def k_tr_only(xw_ref, vj_ref, out_ref):
+        out_ref[...] = jnp.transpose(vj_ref[...]).reshape(32, SUB, LANES)
+
+    def k_xor_only(xw_ref, vj_ref, out_ref):
+        vjp = vj_ref[...].reshape(32, SUB, LANES)  # bitcast, wrong values
+        for i in range(32):
+            out_ref[i] = xw_ref[i] ^ vjp[i]
+
+    def k_salsa_only(xw_ref, vj_ref, out_ref):
+        vjp = vj_ref[...].reshape(32, SUB, LANES)
+        words = [xw_ref[i] ^ vjp[i] for i in range(32)]
+        mixed = _block_mix_words(words)
+        for i in range(32):
+            out_ref[i] = mixed[i]
+
+    def k_full(xw_ref, vj_ref, out_ref):
+        vjt = jnp.transpose(vj_ref[...]).reshape(32, SUB, LANES)
+        words = [xw_ref[i] ^ vjt[i] for i in range(32)]
+        mixed = _block_mix_words(words)
+        for i in range(32):
+            out_ref[i] = mixed[i]
+
+    SUBG1 = B // LANES
+
+    def k_full_g1(xw_ref, vj_ref, out_ref):
+        vjt = jnp.transpose(vj_ref[...]).reshape(32, SUBG1, LANES)
+        words = [xw_ref[i] ^ vjt[i] for i in range(32)]
+        mixed = _block_mix_words(words)
+        for i in range(32):
+            out_ref[i] = mixed[i]
+
+    # tr_dense works on a different carry shape: (128, B/4)
+    def k_tr_dense(xw_ref, vj_ref, out_ref):
+        out_ref[...] = xw_ref[...] ^ jnp.transpose(vj_ref[...])
+
+    def tr_dense_call(xw, vj):
+        return pl.pallas_call(
+            k_tr_dense,
+            out_shape=jax.ShapeDtypeStruct((LANES, B // 4), jnp.uint32),
+            grid=(B // BB,),
+            in_specs=[
+                pl.BlockSpec((LANES, BB // 4), lambda i: (0, i),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((BB // 4, LANES), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((LANES, BB // 4), lambda i: (0, i),
+                                   memory_space=pltpu.VMEM),
+        )(xw, vj)
+
+    # ---- scans ----
+    def scan_wm(call):
+        @jax.jit
+        def run(x, v):
+            def to_wm_bitcast(a):  # free relayout, just to shape the carry
+                return a.reshape(32, B // LANES, LANES)
+
+            xw = to_wm_bitcast(x)
+
+            def body(carry, _):
+                j = carry[16].reshape(B) & np.uint32(N - 1)
+                vj = v[(j * np.uint32(B) + lane).astype(jnp.int32)]
+                return call(carry, vj), None
+
+            xw, _ = jax.lax.scan(body, xw, None, length=STEPS, unroll=UNROLL)
+            return xw[0, 0]
+
+        return run
+
+    @jax.jit
+    def run_tr_dense(x, v):
+        xw = x.reshape(B // 4, LANES)
+        xw = jnp.transpose(xw)  # (128, B/4) carry
+
+        def body(carry, _):
+            j = carry[16].reshape(B // 4)[:B].astype(jnp.uint32)  # junk-but-
+            j = j & np.uint32(N - 1)  # data-dependent chain
+            j = jnp.concatenate([j, j, j, j])[:B]
+            vj = v[(j * np.uint32(B) + lane).astype(jnp.int32)]
+            return tr_dense_call(carry, vj.reshape(B // 4, LANES)), None
+
+        carry, _ = jax.lax.scan(body, xw, None, length=STEPS, unroll=UNROLL)
+        return carry[0]
+
+    cases = [
+        ("tr_only", scan_wm(wm_call(k_tr_only, BB))),
+        ("xor_only", scan_wm(wm_call(k_xor_only, BB))),
+        ("salsa_only", scan_wm(wm_call(k_salsa_only, BB))),
+        ("full", scan_wm(wm_call(k_full, BB))),
+        ("full_g1", scan_wm(wm_call(k_full_g1, B))),
+        ("tr_dense", run_tr_dense),
+    ]
+    for name, fn in cases:
+        try:
+            t = timed(fn, x, vflat) / STEPS
+            print(f"{name:12s} {t * 1e6:8.1f} us/step")
+        except Exception as e:  # noqa: BLE001
+            print(f"{name:12s} FAILED: {type(e).__name__}: {str(e)[:200]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Round-5 scrypt lever, take 2: hand Pallas the WORD-MAJOR view.
+
+hlo_layout_check found the smoking gun: XLA's row-gather naturally
+produces its (B,32) output in layout {0,1} — word-major bytes — and
+the 550 us/step was %copy.5, the layout conversion to the row-major
+{1,0} operand layout pallas demands.  The data is already word-major
+in memory; asking for it row-major un-transposes it at 3.6 GB/s.
+
+Fix under test: transpose the gather output LOGICALLY in XLA
+(``vj.T.reshape(32, B//128, 128)``) so the pallas operand's default
+{2,1,0} layout lands on the same bytes the gather already wrote (a
+bitcast, if layout assignment cooperates), and the kernel does pure
+xor + BlockMix on dense word planes — no transpose anywhere.
+
+Stages:
+  1. bit-exactness of the fused walk vs the shipping body (4 chained
+     steps, real data-dependent gathers).
+  2. 1024-step walk scan: fused vs shipping, us/step.
+  3. grep the compiled HLO: is there still a >64 KiB copy in the body?
+
+Run on the real chip: ``python scripts/walk_wm_probe.py``.
+"""
+
+import re
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/tpuminter-jax-cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from tpuminter.ops.scrypt import _block_mix_words  # noqa: E402
+
+B = 16384
+N = 1024
+LANES = 128
+ROWS = B // LANES
+BLOCK_B = 2048
+SUB = BLOCK_B // LANES
+STEPS = N
+UNROLL = 2
+
+
+def sync(x):
+    np.asarray(jax.tree.leaves(x)[0])
+
+
+def timed(fn, *args, reps=3):
+    out = fn(*args)
+    sync(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _xs_kernel(xw_ref, vj_ref, out_ref):
+    words = [xw_ref[i] ^ vj_ref[i] for i in range(32)]
+    mixed = _block_mix_words(words)
+    for i in range(32):
+        out_ref[i] = mixed[i]
+
+
+def fused_xor_salsa(xw, vjt):
+    spec = pl.BlockSpec((32, SUB, LANES), lambda i: (0, i, 0),
+                        memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _xs_kernel,
+        out_shape=jax.ShapeDtypeStruct((32, ROWS, LANES), jnp.uint32),
+        grid=(B // BLOCK_B,),
+        in_specs=[spec, spec],
+        out_specs=spec,
+    )(xw, vjt)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x_np = rng.integers(0, 2**32, (B, 32), dtype=np.uint32)
+    x = jnp.asarray(x_np)
+
+    @jax.jit
+    def make_v():
+        i = jnp.arange(N * B, dtype=jnp.uint32)[:, None]
+        j = jnp.arange(32, dtype=jnp.uint32)[None, :]
+        h = i * np.uint32(2654435761) + j * np.uint32(0x9E3779B9)
+        h ^= h >> 16
+        h *= np.uint32(0x85EBCA6B)
+        h ^= h >> 13
+        return h
+
+    vflat = make_v()
+    sync(vflat)
+    lane = jnp.arange(B, dtype=jnp.uint32)
+
+    def gather(v, j):
+        return v[(j * np.uint32(B) + lane).astype(jnp.int32)]
+
+    def wm_body(carry, vj):
+        # the barrier pins the gather output to its NATIVE {0,1}
+        # (word-major) layout; without it layout assignment propagates
+        # the custom call's default-layout preference back into the
+        # gather and materializes {1,0} first — a 550 us transpose
+        # (gather_materialize_probe: barrier_tr 86 us vs 670 without)
+        vj = jax.lax.optimization_barrier(vj)
+        vjt = jnp.transpose(vj).reshape(32, ROWS, LANES)
+        return fused_xor_salsa(carry, vjt)
+
+    # ---- stage 1: bit-exactness over 4 chained steps ----
+    @partial(jax.jit, static_argnums=2)
+    def ref_steps(x, v, k):
+        words = tuple(x[:, i] for i in range(32))
+        for _ in range(k):
+            j = words[16] & np.uint32(N - 1)
+            vjk = gather(v, j)
+            mixed = [c ^ vjk[:, i] for i, c in enumerate(words)]
+            words = tuple(_block_mix_words(mixed))
+        return jnp.stack(words, axis=-1)
+
+    @partial(jax.jit, static_argnums=2)
+    def fused_steps(x, v, k):
+        xw = jnp.transpose(x).reshape(32, ROWS, LANES)
+        for _ in range(k):
+            j = xw[16].reshape(B) & np.uint32(N - 1)
+            xw = wm_body(xw, gather(v, j))
+        return jnp.transpose(xw.reshape(32, B))
+
+    ref = np.asarray(ref_steps(x, vflat, 4))
+    got = np.asarray(fused_steps(x, vflat, 4))
+    exact = bool((ref == got).all())
+    print(f"stage1 fused 4-step chain: exact={exact}")
+    if not exact:
+        raise SystemExit("fused kernel wrong — stop here")
+
+    # ---- stage 2: 1024-step walk scan timing ----
+    @jax.jit
+    def walk_ref(x, v):
+        words = tuple(x[:, i] for i in range(32))
+
+        def body(carry, _):
+            j = carry[16] & np.uint32(N - 1)
+            vjk = gather(v, j)
+            mixed = [c ^ vjk[:, i] for i, c in enumerate(carry)]
+            return tuple(_block_mix_words(mixed)), None
+
+        words, _ = jax.lax.scan(body, words, None, length=STEPS, unroll=UNROLL)
+        return words[0]
+
+    @jax.jit
+    def walk_fused(x, v):
+        xw = jnp.transpose(x).reshape(32, ROWS, LANES)
+
+        def body(carry, _):
+            j = carry[16].reshape(B) & np.uint32(N - 1)
+            return wm_body(carry, gather(v, j)), None
+
+        xw, _ = jax.lax.scan(body, xw, None, length=STEPS, unroll=UNROLL)
+        return xw[0, 0]
+
+    t_ref = timed(walk_ref, x, vflat) / STEPS
+    t_fused = timed(walk_fused, x, vflat) / STEPS
+    print(f"stage2 walk scan: shipping {t_ref * 1e6:8.1f} us/step")
+    print(f"                  fused    {t_fused * 1e6:8.1f} us/step "
+          f"({t_ref / t_fused:.2f}x)")
+
+    # ---- stage 3: any big copies left in the loop body? ----
+    txt = jax.jit(walk_fused).lower(x, vflat).compile().as_text()
+    big = [l.strip()[:160] for l in txt.splitlines()
+           if re.search(r"= \S*u32\[(16384,32|32,16384|32,128,128)\]\S* "
+                        r"(copy|transpose)\(", l.strip())]
+    print(f"stage3 body-sized copies/transposes in HLO: {len(big)}")
+    for l in big[:6]:
+        print("  ", l)
+
+
+if __name__ == "__main__":
+    main()

@@ -129,6 +129,9 @@ MAX_AUDIT_RETRIES = 2
 #: chunk budget is divided by the hash-cost ratio at carve time, floored
 #: at SCRYPT_MIN_CHUNK so slow workers still amortize the RPC round-trip
 #: (~0.15 s of hashlib.scrypt at the measured ~300 µs/hash).
+#: (On jobs smaller than 2×SCRYPT_MIN_CHUNK the half-job anti-monopoly
+#: cap in ``_budget`` wins over this floor — intentionally: tiny jobs
+#: can't amortize the RPC anyway, and monopoly protection matters more.)
 SCRYPT_CHUNK_DIVISOR = 8192
 SCRYPT_MIN_CHUNK = 512
 
@@ -965,6 +968,10 @@ class Coordinator:
             budget = max(SCRYPT_MIN_CHUNK, budget // SCRYPT_CHUNK_DIVISOR)
         elif miner.span > 1:
             budget = max(budget, SPANS_PER_DISPATCH * miner.span)
+            # round down to a whole number of spans: a chunk ending
+            # mid-span still refills the worker pipeline once per chunk
+            # (a smaller version of the 9% single-span drain cost)
+            budget -= budget % miner.span
         # One dispatch never exceeds half the job: lanes/span are
         # unvalidated wire hints, and a worker advertising huge ones
         # would otherwise take whole jobs as single chunks that no other
@@ -972,7 +979,14 @@ class Coordinator:
         # could then hold a job hostage. Half-job keeps at least two
         # carves per job, so a second worker can always participate.
         req = job.request
-        return min(budget, max(1, (req.upper - req.lower + 2) // 2))
+        budget = min(budget, max(1, (req.upper - req.lower + 2) // 2))
+        if job.request.mode != PowMode.SCRYPT and miner.span > 1:
+            # the cap can re-break span alignment on small jobs; re-round
+            # while at least one whole span remains (below that, a
+            # mid-span chunk is unavoidable and exhaustion wins)
+            if budget > miner.span:
+                budget -= budget % miner.span
+        return budget
 
     def _assign(self, miner: _MinerState, job: _Job, lo: int, hi: int) -> bool:
         """Book-keep + write one chunk dispatch; False if the write
