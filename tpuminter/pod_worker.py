@@ -118,6 +118,7 @@ class PodMiner(Miner):
         tiles_per_step: int = 8,
         exact_min: bool = False,
         spmd_leader: bool = False,
+        scrypt_batch: Optional[int] = None,
     ):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_dev = int(self.mesh.devices.size)
@@ -141,6 +142,10 @@ class PodMiner(Miner):
             else max(self.n_dev, self.n_dev * (slab_per_device * 4) // 16_384)
         )
         self.exact_min = exact_min
+        #: per-chip scrypt batch override (default: the measured-optimal
+        #: 16384 on TPU / 64 on the CPU mesh); tests shrink it so a
+        #: bit-exact host cross-check stays affordable
+        self.scrypt_batch = scrypt_batch
         self.span = self.pod_span
         #: multi-host mode: this process is the control-plane leader and
         #: mirrors its request/step stream to follower processes (see
@@ -381,6 +386,14 @@ class PodMiner(Miner):
 
     # -- TARGET with exact min tracking (--exact-min) ----------------------
 
+    @property
+    def exact_min_span(self) -> int:
+        """Nonces one exact-min device call covers (the ``--exact-min``
+        sweep caps its per-chip batch at 2^16: full digests are 32× the
+        candidate kernel's memory per nonce). Exposed so bench/test
+        code never re-derives the formula."""
+        return self.n_dev * self.n_slabs * min(self.slab_per_device, 1 << 16)
+
     def _mine_target_exact(self, req: Request) -> Iterator[Optional[Result]]:
         """TARGET via ``build_target_sweep``: full digests on every chip
         (no candidate shortcut), pod-wide winner or-reduce AND an exact
@@ -395,7 +408,7 @@ class PodMiner(Miner):
                 self.mesh, template, batch_per_device=bpd,
                 n_batches=self.n_slabs,
             )
-        span = self.n_dev * self.n_slabs * bpd
+        span = self.exact_min_span
         target_words = jnp.asarray(ops.target_to_words(req.target))
         limit = jnp.uint32(req.upper)
         best: Optional[Tuple[int, int]] = None  # (hash, nonce)
@@ -529,7 +542,9 @@ class PodMiner(Miner):
         from tpuminter.parallel import build_scrypt_sweep
 
         assert req.target is not None
-        bpd = 16384 if jax.default_backend() != "cpu" else 64
+        bpd = self.scrypt_batch or (
+            16384 if jax.default_backend() != "cpu" else 64
+        )
         if self._scrypt_sweep is None:
             self._scrypt_sweep = build_scrypt_sweep(
                 self.mesh, batch_per_device=bpd
