@@ -299,20 +299,30 @@ def bench_pod(span: int = 1 << 32) -> dict:
 
     # compile + warm: one full pod span
     _drain_pod(miner, job(0, miner.pod_span - 1, 98))
-    t_span = min(
-        _timed(lambda i=i: _drain_pod(miner, job(0, miner.pod_span - 1, i)))
-        for i in range(90, 93)
+    t_full = min(
+        _timed(lambda i=i: _drain_pod(miner, job(0, span - 1, i)))
+        for i in range(99, 101)
     )
-    t_full = _timed(lambda: _drain_pod(miner, job(0, span - 1, 99)))
-    per_nonce = (t_full - t_span) / (span - miner.pod_span)
-    fill = t_span - per_nonce * miner.pod_span
-    return {
-        "pod_ghs_per_chip": round(span / t_full / miner.n_dev / 1e9, 3),
-        "pod_fill_ms": round(fill * 1e3, 1),
-        "pod_ghs_per_chip_fill_corrected": round(
+    out = {"pod_ghs_per_chip": round(span / t_full / miner.n_dev / 1e9, 3)}
+    if span > miner.pod_span:
+        # same statistic on both fit points (min-of-k) — the tunnel's
+        # 67-142 ms dispatch jitter is the magnitude of the fill itself
+        t_span = min(
+            _timed(
+                lambda i=i: _drain_pod(miner, job(0, miner.pod_span - 1, i))
+            )
+            for i in range(90, 93)
+        )
+        per_nonce = (t_full - t_span) / (span - miner.pod_span)
+        fill = t_span - per_nonce * miner.pod_span
+        out["pod_fill_ms"] = round(fill * 1e3, 1)
+        out["pod_ghs_per_chip_fill_corrected"] = round(
             1 / per_nonce / miner.n_dev / 1e9, 3
-        ),
-    }
+        )
+    # else: pod_span == span (e.g. a v5e-8's 8×4×2^27 = 2^32) — one
+    # dispatch IS the whole job; there is no second fit point, and the
+    # fill fields are honestly unmeasurable rather than fabricated
+    return out
 
 
 def bench_pod_min(spans: int = 8) -> float:

@@ -1012,3 +1012,232 @@ def test_client_sees_disconnected_when_coordinator_dies_mid_job():
             await asyncio.gather(job, return_exceptions=True)
 
     run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# long-lived coordinator soak (VERDICT r4 missing #3)
+# ---------------------------------------------------------------------------
+
+def test_coordinator_soak_50_jobs_drains_all_bookkeeping():
+    """One coordinator through 50 mixed-mode jobs with every optional
+    subsystem on at once — audits at rate 1.0, hedging armed, a lying
+    worker evicted mid-run, a healthy worker hard-killed mid-run — then
+    prove the process could run forever: every internal map (_jobs,
+    _rotation, _audit_queue, _audits, per-miner chunks, per-client job
+    sets) drains to empty and stats_snapshot reports zero queue depth.
+    The reference's coordinator runs indefinitely; seconds-long
+    scenarios alone cannot catch bookkeeping that leaks per job."""
+    from tpuminter.lsp import LspClient, LspConnectionLost
+    from tpuminter.protocol import Assign, Join, Setup, decode_msg, encode_msg
+
+    data = b"soak job payload"
+    gn = chain.GENESIS_HEADER.nonce
+    diff1 = chain.bits_to_target(0x1D00FFFF)
+    hdr = chain.GENESIS_HEADER.pack()
+
+    def make_requests():
+        reqs = []
+        for i in range(50):
+            jid = 100 + i
+            kind = i % 10
+            if kind == 8:  # TARGET that finds the genesis winner
+                reqs.append((jid, Request(
+                    job_id=jid, mode=PowMode.TARGET, lower=gn - 1200,
+                    upper=gn + 800, header=hdr, target=diff1,
+                ), ("target-found",)))
+            elif kind == 9:  # TARGET exhausted (best-effort min)
+                reqs.append((jid, Request(
+                    job_id=jid, mode=PowMode.TARGET, lower=i * 100,
+                    upper=i * 100 + 1499, header=hdr, target=1,
+                ), ("target-miss",)))
+            elif kind == 7:  # SCRYPT exhausted (memory-hard: slow). The
+                # kill batch's scrypt job is sized so one chunk takes
+                # ~180 ms — long enough that the mid-batch kill below
+                # provably lands while it is in flight.
+                reqs.append((jid, Request(
+                    job_id=jid, mode=PowMode.SCRYPT, lower=0,
+                    upper=1199 if i == 27 else 59 + i,
+                    header=hdr, target=1,
+                ), ("scrypt",)))
+            else:  # MIN with per-job payload and varying ranges
+                lo = 37 * i
+                reqs.append((jid, Request(
+                    job_id=jid, mode=PowMode.MIN, lower=lo,
+                    upper=lo + 2000 + 100 * (i % 5), data=data + bytes([i]),
+                ), ("min",)))
+        return reqs
+
+    async def scenario():
+        # batch=64 keeps yield (= cancellation) points dense: the mid-
+        # soak hard-kill below must interrupt a chunk MID-COMPUTE, and a
+        # miner that crunches a whole chunk in one synchronous step can
+        # slip its Result out before task cancellation is delivered
+        cluster = await Cluster.create(
+            n_miners=3, chunk_size=512, audit_rate=1.0, audit_seed=11,
+            hedge_after=0.25, miner_factory=lambda: CpuMiner(batch=64),
+        )
+        coord = cluster.coord
+        try:
+            # a verifiable-but-lying worker (the lazy pattern): answers
+            # every MIN dispatch instantly with its range's first nonce
+            liar = await LspClient.connect("127.0.0.1", coord.port, FAST)
+            liar.write(encode_msg(Join(backend="liar", lanes=1)))
+
+            async def be_lazy():
+                modes = {}
+                try:
+                    while True:
+                        msg = decode_msg(await liar.read())
+                        if isinstance(msg, Setup):
+                            modes[msg.request.job_id] = msg.request
+                        elif isinstance(msg, Assign):
+                            req = modes[msg.job_id]
+                            if req.mode != PowMode.MIN:
+                                continue  # stall non-MIN: hedging covers
+                            liar.write(encode_msg(Result(
+                                msg.job_id, req.mode, nonce=msg.lower,
+                                hash_value=chain.toy_hash(req.data, msg.lower),
+                                found=True,
+                                searched=msg.upper - msg.lower + 1,
+                                chunk_id=msg.chunk_id,
+                            )))
+                except LspConnectionLost:
+                    pass  # evicted, as expected
+
+            liar_task = asyncio.ensure_future(be_lazy())
+            await asyncio.sleep(0.05)
+
+            reqs = make_requests()
+            results = {}
+            for batch_start in range(0, len(reqs), 10):
+                batch = reqs[batch_start:batch_start + 10]
+                futures = [
+                    asyncio.ensure_future(
+                        submit("127.0.0.1", coord.port, req, params=FAST)
+                    )
+                    for _, req, _ in batch
+                ]
+                if batch_start == 20:
+                    # hard-kill the cpu fleet WHILE this batch is in
+                    # flight: inflight JOB chunks (not audits — those
+                    # requeue to the audit queue, uncounted) must go
+                    # back to their jobs. Gate on a cpu miner holding a
+                    # non-audit chunk of the batch's slow scrypt job
+                    # (~180 ms per chunk), so the kill provably lands
+                    # mid-chunk — audit-first dispatch otherwise makes
+                    # the victim hold an audit deterministically.
+                    import time as _time
+
+                    def cpu_holds_scrypt_job_chunk():
+                        now = _time.monotonic()
+                        for m in coord._miners.values():
+                            if (
+                                m.backend == "cpu"
+                                and m.chunk is not None
+                                and m.chunk[0] not in coord._audits
+                                # freshly dispatched: the holder is at
+                                # most ~0.12 s into a ~0.18 s chunk, so
+                                # the kill cannot race its completion
+                                and now - m.chunk_at < 0.12
+                            ):
+                                job = coord._jobs.get(m.chunk[1])
+                                if (job is not None and
+                                        job.request.mode == PowMode.SCRYPT):
+                                    return True
+                        return False
+
+                    for _ in range(1500):
+                        if cpu_holds_scrypt_job_chunk():
+                            break
+                        await asyncio.sleep(0.01)
+                    else:
+                        raise AssertionError("no cpu miner took the "
+                                             "scrypt chunk")
+                    requeued_before = coord.stats["chunks_requeued"]
+                    # cancel ALL tasks before awaiting any: sequential
+                    # kill_miner awaits each task's close-drain, during
+                    # which a later victim can finish its chunk and slip
+                    # the Result out — defeating the mid-chunk kill
+                    victims = [t for t in cluster.miner_tasks
+                               if not t.done()]
+                    for t in victims:
+                        t.cancel()
+                    await asyncio.gather(*victims, return_exceptions=True)
+                    for _ in range(3):
+                        await cluster.add_miner(CpuMiner(batch=64))
+                outs = await asyncio.gather(*futures)
+                for (jid, _, _), out in zip(batch, outs):
+                    results[jid] = out
+
+            # the mid-batch kill provably exercised death-requeue
+            assert coord.stats["chunks_requeued"] > requeued_before
+
+            # every job's answer is exact despite liar/death/hedges
+            for jid, req, tag in reqs:
+                out = results[jid]
+                assert out.job_id == jid
+                if tag[0] == "min":
+                    want = brute_min(req.data, req.lower, req.upper)
+                    assert (out.hash_value, out.nonce) == want, (jid, tag)
+                    assert out.found
+                elif tag[0] == "target-found":
+                    assert out.found and out.nonce == gn
+                elif tag[0] == "target-miss":
+                    assert not out.found
+                    want = min(
+                        (chain.hash_to_int(chain.dsha256(
+                            hdr[:76] + struct.pack("<I", n))), n)
+                        for n in range(req.lower, req.upper + 1)
+                    )
+                    assert (out.hash_value, out.nonce) == want, jid
+                else:  # scrypt exhausted: exact min of the range
+                    want = min(
+                        (chain.hash_to_int(chain.scrypt_hash(
+                            hdr[:76] + struct.pack("<I", n))), n)
+                        for n in range(req.lower, req.upper + 1)
+                    )
+                    assert not out.found
+                    assert (out.hash_value, out.nonce) == want, jid
+
+            # the liar was caught and evicted along the way
+            assert coord.stats["audits_failed"] >= 1
+            assert all(
+                s["backend"] != "liar" for s in coord.worker_stats().values()
+            )
+            assert coord.stats["jobs_done"] >= 50
+
+            # drain: audits may outlive their jobs by design; give the
+            # fleet a bounded window to settle every trailing audit
+            deadline = asyncio.get_event_loop().time() + 20.0
+            while asyncio.get_event_loop().time() < deadline:
+                snap = coord.stats_snapshot()
+                busy = any(
+                    w["busy"] for w in snap["workers"].values()
+                )
+                if (
+                    snap["jobs_active"] == 0
+                    and snap["chunks_queued"] == 0
+                    and snap["audits_queued"] == 0
+                    and not busy
+                ):
+                    break
+                await asyncio.sleep(0.1)
+
+            # the leak-free guarantee, on the raw internals
+            assert coord._jobs == {}, coord._jobs
+            assert not coord._rotation, coord._rotation
+            assert not coord._audit_queue, coord._audit_queue
+            assert coord._audits == {}, coord._audits
+            for m in coord._miners.values():
+                assert m.chunk is None, (m.conn_id, m.chunk)
+            assert not any(coord._clients.values()), coord._clients
+            snap = coord.stats_snapshot()
+            assert snap["jobs_active"] == 0
+            assert snap["chunks_queued"] == 0
+            assert snap["audits_queued"] == 0
+            liar_task.cancel()
+            await asyncio.gather(liar_task, return_exceptions=True)
+        finally:
+            await cluster.close()
+
+    run(scenario(), timeout=240.0)

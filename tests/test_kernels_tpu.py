@@ -272,6 +272,7 @@ def _tpu_env():
 
 
 _TPU_AVAILABLE = None  # cached module-wide: one probe, not one per section
+_TPU_PROBE_OUTPUT = ""  # the probe's stdout+stderr, kept for diagnostics
 
 
 def _skip_unless_tpu():
@@ -279,13 +280,14 @@ def _skip_unless_tpu():
     the no-TPU skip path boots a full JAX subprocess per section (tens
     of seconds each on this 1-core host) just to rediscover the same
     answer."""
-    global _TPU_AVAILABLE
+    global _TPU_AVAILABLE, _TPU_PROBE_OUTPUT
     if _TPU_AVAILABLE is None:
         proc = subprocess.run(
             [sys.executable, "-c",
              "import jax; print('BACKEND=' + jax.default_backend())"],
             env=_tpu_env(), capture_output=True, text=True, timeout=180,
         )
+        _TPU_PROBE_OUTPUT = f"{proc.stdout}\n{proc.stderr[-1500:]}"
         _TPU_AVAILABLE = (
             proc.returncode == 0 and "BACKEND=" in proc.stdout
             and "BACKEND=cpu" not in proc.stdout
@@ -297,7 +299,7 @@ def _skip_unless_tpu():
         if os.environ.get("TPUMINTER_REQUIRE_TPU") == "1":
             pytest.fail(
                 "TPU required (TPUMINTER_REQUIRE_TPU=1) but no TPU "
-                "backend reachable"
+                f"backend reachable; probe said:\n{_TPU_PROBE_OUTPUT}"
             )
         pytest.skip(
             "NO TPU REACHABLE — the compiled Pallas kernels were NOT "

@@ -387,12 +387,18 @@ class PodMiner(Miner):
     # -- TARGET with exact min tracking (--exact-min) ----------------------
 
     @property
+    def _exact_bpd(self) -> int:
+        """Per-chip batch of the exact-min sweep, capped at 2^16 (full
+        digests are 32× the candidate kernel's memory per nonce)."""
+        return min(self.slab_per_device, 1 << 16)
+
+    @property
     def exact_min_span(self) -> int:
-        """Nonces one exact-min device call covers (the ``--exact-min``
-        sweep caps its per-chip batch at 2^16: full digests are 32× the
-        candidate kernel's memory per nonce). Exposed so bench/test
-        code never re-derives the formula."""
-        return self.n_dev * self.n_slabs * min(self.slab_per_device, 1 << 16)
+        """Nonces one exact-min device call covers. Exposed so bench/
+        test code (and ``_mine_target_exact`` itself) never re-derives
+        the formula — the loop stride and the compiled sweep's coverage
+        must come from one place or they drift apart silently."""
+        return self.n_dev * self.n_slabs * self._exact_bpd
 
     def _mine_target_exact(self, req: Request) -> Iterator[Optional[Result]]:
         """TARGET via ``build_target_sweep``: full digests on every chip
@@ -401,7 +407,7 @@ class PodMiner(Miner):
         range minimum like CpuMiner does."""
         assert req.header is not None and req.target is not None
         template = ops.header_template(req.header)
-        bpd = min(self.slab_per_device, 1 << 16)
+        bpd = self._exact_bpd
         if self._exact_sweep is None or template != self._exact_template:
             self._exact_template = template
             self._exact_sweep = build_target_sweep(
