@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Round-5 follow-up: unroll the FILL scan harder than the walk.
+
+PERF.md: the walk rejected unroll=4 (gather-overlap pairing breaks) and
+the shipping compromise is unroll=2 on BOTH scans. But the fill scan
+has no gather — its ~100 us/step is mostly the ~90 us axon loop floor,
+and 1024 fill steps are ~13% of the whole scrypt pipeline. A higher
+fill-only unroll halves that floor share without touching the walk.
+
+Times full ROMix (fill+walk, B=16384, N=1024) for (fill_unroll,
+walk_unroll) in {(2,2) shipping, (4,2), (8,2)}; exactness pinned
+against the shipping output.
+
+Run on the real chip: ``python scripts/romix_fill_unroll_probe.py``.
+"""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/tpuminter-jax-cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from tpuminter.ops.scrypt import _block_mix_words  # noqa: E402
+
+B = 16384
+N_LOG2 = 10
+N = 1 << N_LOG2
+
+
+def sync(x):
+    np.asarray(jax.tree.leaves(x)[0])
+
+
+def timed(fn, *args, reps=3):
+    out = fn(*args)
+    sync(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        sync(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def romix_u(x, fill_unroll, walk_unroll):
+    batch = x.shape[0]
+    lane = jnp.arange(batch, dtype=jnp.uint32)
+    words = tuple(x[:, i] for i in range(32))
+
+    def fill(carry, _):
+        return tuple(_block_mix_words(list(carry))), jnp.stack(carry, axis=-1)
+
+    words, v = jax.lax.scan(fill, words, None, length=N, unroll=fill_unroll)
+    vflat = v.reshape(N * batch, 32)
+
+    def walk(carry, _):
+        j = carry[16] & np.uint32(N - 1)
+        vj = vflat[(j * np.uint32(batch) + lane).astype(jnp.int32)]
+        mixed = [c ^ vj[:, i] for i, c in enumerate(carry)]
+        return tuple(_block_mix_words(mixed)), None
+
+    words, _ = jax.lax.scan(walk, words, None, length=N, unroll=walk_unroll)
+    return jnp.stack(words, axis=-1)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 2**32, (B, 32), dtype=np.uint32))
+
+    ref = None
+    for fill_u, walk_u in [(2, 2), (4, 2), (8, 2)]:
+        t = timed(romix_u, x, fill_u, walk_u)
+        out = np.asarray(romix_u(x, fill_u, walk_u)[:64])  # small pull
+        if ref is None:
+            ref = out
+        exact = bool((out == ref).all())
+        rate = B / t
+        print(f"fill={fill_u} walk={walk_u}: {t * 1e3:7.1f} ms "
+              f"({rate / 1e3:.1f} kH/s-equiv romix-only) exact={exact}")
+
+
+if __name__ == "__main__":
+    main()
