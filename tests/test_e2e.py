@@ -1107,6 +1107,56 @@ def test_coordinator_soak_50_jobs_drains_all_bookkeeping():
             liar_task = asyncio.ensure_future(be_lazy())
             await asyncio.sleep(0.05)
 
+            def true_result(req, msg):
+                """Brute-force the exact answer for a small assign —
+                the mute worker stays in good standing on audits."""
+                lo, hi = msg.lower, msg.upper
+                if req.mode == PowMode.MIN:
+                    h, n = brute_min(req.data, lo, hi)
+                    return Result(msg.job_id, req.mode, n, h, found=True,
+                                  searched=hi - lo + 1, chunk_id=msg.chunk_id)
+                fn = (chain.scrypt_hash if req.mode == PowMode.SCRYPT
+                      else chain.dsha256)
+                pre = req.header[:76]
+                best = None
+                for n in range(lo, hi + 1):
+                    h = chain.hash_to_int(fn(pre + struct.pack("<I", n)))
+                    if h <= req.target:
+                        return Result(msg.job_id, req.mode, n, h, found=True,
+                                      searched=n - lo + 1,
+                                      chunk_id=msg.chunk_id)
+                    if best is None or (h, n) < best:
+                        best = (h, n)
+                return Result(msg.job_id, req.mode, best[1], best[0],
+                              found=False, searched=hi - lo + 1,
+                              chunk_id=msg.chunk_id)
+
+            async def start_mute():
+                mute = await LspClient.connect(
+                    "127.0.0.1", coord.port, FAST
+                )
+                mute.write(encode_msg(Join(backend="mute", lanes=1)))
+
+                async def run_mute():
+                    setups = {}
+                    try:
+                        while True:
+                            msg = decode_msg(await mute.read())
+                            if isinstance(msg, Setup):
+                                setups[msg.request.job_id] = msg.request
+                            elif isinstance(msg, Assign):
+                                if msg.upper - msg.lower + 1 >= 400:
+                                    continue  # stall the real job chunk
+                                mute.write(encode_msg(
+                                    true_result(setups[msg.job_id], msg)
+                                ))
+                    except LspConnectionLost:
+                        pass
+
+                task = asyncio.ensure_future(run_mute())
+                await asyncio.sleep(0.05)
+                return mute, task
+
             reqs = make_requests()
             results = {}
             for batch_start in range(0, len(reqs), 10):
@@ -1118,46 +1168,11 @@ def test_coordinator_soak_50_jobs_drains_all_bookkeeping():
                     for _, req, _ in batch
                 ]
                 if batch_start == 20:
-                    # hard-kill the cpu fleet WHILE this batch is in
-                    # flight: inflight JOB chunks (not audits — those
-                    # requeue to the audit queue, uncounted) must go
-                    # back to their jobs. Gate on a cpu miner holding a
-                    # non-audit chunk of the batch's slow scrypt job
-                    # (~180 ms per chunk), so the kill provably lands
-                    # mid-chunk — audit-first dispatch otherwise makes
-                    # the victim hold an audit deterministically.
-                    import time as _time
-
-                    def cpu_holds_scrypt_job_chunk():
-                        now = _time.monotonic()
-                        for m in coord._miners.values():
-                            if (
-                                m.backend == "cpu"
-                                and m.chunk is not None
-                                and m.chunk[0] not in coord._audits
-                                # freshly dispatched: the holder is at
-                                # most ~0.12 s into a ~0.18 s chunk, so
-                                # the kill cannot race its completion
-                                and now - m.chunk_at < 0.12
-                            ):
-                                job = coord._jobs.get(m.chunk[1])
-                                if (job is not None and
-                                        job.request.mode == PowMode.SCRYPT):
-                                    return True
-                        return False
-
-                    for _ in range(1500):
-                        if cpu_holds_scrypt_job_chunk():
-                            break
-                        await asyncio.sleep(0.01)
-                    else:
-                        raise AssertionError("no cpu miner took the "
-                                             "scrypt chunk")
-                    requeued_before = coord.stats["chunks_requeued"]
-                    # cancel ALL tasks before awaiting any: sequential
-                    # kill_miner awaits each task's close-drain, during
-                    # which a later victim can finish its chunk and slip
-                    # the Result out — defeating the mid-chunk kill
+                    # hard-kill the whole cpu fleet mid-batch with
+                    # simultaneous cancels (sequential kills let a
+                    # victim finish a chunk during close-drain) — the
+                    # chaos ingredient; requeue ATTRIBUTION has its own
+                    # deterministic phase after the soak loop
                     victims = [t for t in cluster.miner_tasks
                                if not t.done()]
                     for t in victims:
@@ -1169,7 +1184,58 @@ def test_coordinator_soak_50_jobs_drains_all_bookkeeping():
                 for (jid, _, _), out in zip(batch, outs):
                     results[jid] = out
 
-            # the mid-batch kill provably exercised death-requeue
+            # deterministic death-requeue attribution, as its own phase
+            # (during the soak batches, audit-first dispatch starves a
+            # late joiner of job chunks ~20% of runs): a MUTE worker
+            # that answers small assigns correctly (audits are <=
+            # AUDIT_SAMPLE = 256 nonces, so it stays in good standing)
+            # but STALLS any >= 400-nonce job chunk — held inflight
+            # with no completion race possible. Closing its connection
+            # must route that chunk through the COUNTED requeue path,
+            # and the job then completes exact on the survivors.
+            # Hedging is parked for this phase: the queue drains in
+            # ~0.2 s (toy chunks are ~1 ms), after which a hedge copy
+            # of the stalled chunk would win the race against epoch
+            # loss and release it through the UNCOUNTED settle path —
+            # the hedging subsystem doing its job, but not the path
+            # under test here.
+            coord._hedge_after = 1e9  # ticker re-reads it each cycle
+            mute, mute_task = await start_mute()
+            attribution = Request(
+                job_id=999, mode=PowMode.MIN, lower=0, upper=511_999,
+                data=b"requeue attribution",
+            )
+            fut = asyncio.ensure_future(submit(
+                "127.0.0.1", coord.port, attribution, params=FAST
+            ))
+            for _ in range(3000):
+                if any(
+                    m.backend == "mute" and m.chunk is not None
+                    and m.chunk[0] not in coord._audits
+                    for m in coord._miners.values()
+                ):
+                    break
+                await asyncio.sleep(0.01)
+            else:
+                dump = {
+                    cid: (m.backend, m.chunk,
+                          m.chunk is not None
+                          and m.chunk[0] in coord._audits)
+                    for cid, m in coord._miners.items()
+                }
+                raise AssertionError(
+                    f"mute never stalled a job chunk; miners={dump} "
+                    f"job999_done={fut.done()} "
+                    f"snap={coord.stats_snapshot()['jobs_active']}"
+                )
+            requeued_before = coord.stats["chunks_requeued"]
+            await mute.close(drain_timeout=0.05)
+            mute_task.cancel()
+            await asyncio.gather(mute_task, return_exceptions=True)
+            out999 = await asyncio.wait_for(fut, 90)
+            assert (out999.hash_value, out999.nonce) == brute_min(
+                attribution.data, 0, 511_999
+            )
             assert coord.stats["chunks_requeued"] > requeued_before
 
             # every job's answer is exact despite liar/death/hedges
