@@ -1051,9 +1051,10 @@ def test_coordinator_soak_50_jobs_drains_all_bookkeeping():
                     upper=i * 100 + 1499, header=hdr, target=1,
                 ), ("target-miss",)))
             elif kind == 7:  # SCRYPT exhausted (memory-hard: slow). The
-                # kill batch's scrypt job is sized so one chunk takes
-                # ~180 ms — long enough that the mid-batch kill below
-                # provably lands while it is in flight.
+                # kill batch's scrypt job is bigger so the best-effort
+                # chaos kill below has slow chunks to land on (the
+                # PROVABLE requeue attribution is the separate
+                # mute-worker phase after the soak loop).
                 reqs.append((jid, Request(
                     job_id=jid, mode=PowMode.SCRYPT, lower=0,
                     upper=1199 if i == 27 else 59 + i,
@@ -1307,3 +1308,71 @@ def test_coordinator_soak_50_jobs_drains_all_bookkeeping():
             await cluster.close()
 
     run(scenario(), timeout=240.0)
+
+
+# ---------------------------------------------------------------------------
+# dispatch budget arithmetic (unit-level: the span-alignment rules)
+# ---------------------------------------------------------------------------
+
+def test_budget_span_alignment_and_caps():
+    """ADVICE r4: chunk budgets for pipelined miners must be whole
+    multiples of the worker's span (a chunk ending mid-span refills the
+    pod pipeline once per chunk), including AFTER the half-job cap; the
+    scrypt floor loses to the half-job cap on tiny jobs by design."""
+    from tpuminter.coordinator import (
+        SCRYPT_MIN_CHUNK, SPANS_PER_DISPATCH, _Job, _MinerState,
+    )
+
+    async def scenario():
+        coord = await Coordinator.create(params=FAST, chunk_size=4096)
+        try:
+            def job(mode, lower, upper):
+                kw = (dict(data=b"x") if mode == PowMode.MIN else
+                      dict(header=chain.GENESIS_HEADER.pack(), target=1))
+                return _Job(job_id=1, client_conn=1, client_job_id=1,
+                            request=Request(job_id=1, mode=mode,
+                                            lower=lower, upper=upper,
+                                            **kw))
+
+            def miner(lanes=1, span=0):
+                return _MinerState(conn_id=9, backend="t", lanes=lanes,
+                                   span=span)
+
+            big = job(PowMode.MIN, 0, (1 << 32) - 1)
+
+            # pipelined miner: budget is a whole number of spans and at
+            # least SPANS_PER_DISPATCH of them
+            m = miner(lanes=7, span=1000)
+            b = coord._budget(m, big)
+            assert b % 1000 == 0
+            assert b >= SPANS_PER_DISPATCH * 1000
+
+            # chunk_size*lanes dominating must still be span-aligned
+            m2 = miner(lanes=1000, span=999)  # 4096*1000 not a multiple
+            b2 = coord._budget(m2, big)
+            assert b2 % 999 == 0 and b2 > 0
+
+            # the half-job cap can land mid-span; the re-round restores
+            # alignment while at least one whole span fits
+            small = job(PowMode.MIN, 0, 2999)  # half-job cap ~1500
+            m3 = miner(lanes=1000, span=700)
+            b3 = coord._budget(m3, small)
+            assert b3 == 1400  # capped to <=1500, re-rounded to 2x700
+            # below one span the cap wins outright (exhaustion beats
+            # alignment on jobs smaller than two spans)
+            tiny = job(PowMode.MIN, 0, 999)
+            b4 = coord._budget(m3, tiny)
+            assert 0 < b4 <= 500
+
+            # scrypt: divisor-scaled with the RPC-amortization floor...
+            sc = job(PowMode.SCRYPT, 0, (1 << 20) - 1)
+            b5 = coord._budget(miner(lanes=1), sc)
+            assert b5 == SCRYPT_MIN_CHUNK
+            # ...which the half-job anti-monopoly cap beats on tiny jobs
+            sc_tiny = job(PowMode.SCRYPT, 0, 599)
+            b6 = coord._budget(miner(lanes=1), sc_tiny)
+            assert b6 == 300  # (599 + 2) // 2, under the 512 floor
+        finally:
+            await coord.close()
+
+    run(scenario())
