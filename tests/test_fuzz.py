@@ -93,7 +93,7 @@ async def _actor(port: int, rng: random.Random, behavior: str) -> None:
         await w.close(drain_timeout=0.5)
 
 
-@pytest.mark.parametrize("seed", [1, 7, 23, 57])
+@pytest.mark.parametrize("seed", [1, 7, 23, 57, 101, 211, 349, 499])
 def test_scheduler_fuzz_exact_answers_despite_hostile_fleet(seed, monkeypatch):
     from tpuminter import coordinator as coord_mod
 
