@@ -292,3 +292,44 @@ def test_coordinator_rejects_forged_scrypt_result(ground_truth):
     assert not Coordinator._verify_result(req, forged)
     honest = Result(1, PowMode.SCRYPT, n_min, h_min, found=True)
     assert Coordinator._verify_result(req, honest)
+
+
+def test_romix_walk_uses_one_flat_row_gather_per_step():
+    """Structural tripwire for the ROMix layout war (PERF.md): the walk
+    body must read V with exactly ONE flat row-gather per scan step —
+    the measured-optimal form (23 GB/s). The rejected layouts that each
+    cost ~100x — ``take_along_axis`` on (N, B, 32), per-word element
+    gathers on word-major V, plane-major element gathers (round 5:
+    7 ms/step) — all trace to a different gather count or shape, so a
+    silent regression to any of them fails here long before a bench
+    run could catch it on hardware."""
+    import jax
+
+    from tpuminter.ops.scrypt import romix
+
+    b, n_log2 = 256, 4
+    jaxpr = jax.make_jaxpr(lambda x: romix(x, n_log2))(
+        jnp.zeros((b, 32), jnp.uint32)
+    )
+
+    def scan_bodies(jx, out):
+        for eq in jx.eqns:
+            for sub in eq.params.values():
+                for item in sub if isinstance(sub, (tuple, list)) else (sub,):
+                    if hasattr(item, "jaxpr"):
+                        if eq.primitive.name == "scan":
+                            out.append(item.jaxpr)
+                        scan_bodies(item.jaxpr, out)
+        return out
+
+    bodies = scan_bodies(jaxpr.jaxpr, [])
+    assert len(bodies) == 2, f"expected fill+walk scans, got {len(bodies)}"
+    gather_shapes = [
+        [tuple(v.aval.shape) for v in eq.outvars]
+        for body in bodies
+        for eq in body.eqns
+        if eq.primitive.name == "gather"
+    ]
+    # exactly one gather in the whole program (the walk's row gather),
+    # producing whole (B, 32) rows
+    assert gather_shapes == [[(b, 32)]], gather_shapes
