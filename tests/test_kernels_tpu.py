@@ -127,6 +127,9 @@ req3 = Request(job_id=3, mode=PowMode.MIN, lower=50, upper=4049, data=b"tpu min"
 r3 = drain(miner.mine(req3))
 want3 = min((chain.toy_hash(b"tpu min", i), i) for i in range(50, 4050))
 assert (r3.hash_value, r3.nonce) == want3
+# the MIN contract (VERDICT r5 next #7), on the pipelined loop: always
+# found=True with full searched accounting
+assert r3.found is True and r3.searched == 4000
 print("SECTION-OK")
 """,
     # --- dynamic-header kernel ≡ baked kernel (extranonce-roll consumer) --
@@ -180,6 +183,8 @@ print("SECTION-OK")
 """,
     # --- pod paths on the real chip (1-chip mesh): the shard_map'd Pallas
     # MIN sweep (full span + ragged tail) and the exact-min TARGET sweep
+    # (build_exact_sweep_pallas: pallas_search_target per chip, pipelined
+    # host loop) — exhausted-min bit-exact vs hashlib AND the winner path
     "pod": r"""
 from tpuminter.parallel import make_mesh
 from tpuminter.pod_worker import PodMiner
@@ -191,9 +196,11 @@ r6 = drain(pm.mine(req6))
 want6 = min((chain.toy_hash(b"pod min tpu", i), i)
             for i in range(10, (1 << 12) + 501))
 assert (r6.hash_value, r6.nonce) == want6
+assert r6.found is True and r6.searched == (1 << 12) + 491  # MIN contract
 
 pe = PodMiner(mesh=make_mesh(jax.devices()[:1]), slab_per_device=256,
               n_slabs=2, kernel="pallas", exact_min=True)
+assert pe.exact_min_span == 256  # pallas engine: one slab per chip
 req7 = Request(job_id=7, mode=PowMode.TARGET, lower=0, upper=999,
                header=GEN.pack(), target=chain.bits_to_target(0x1D00FFFF))
 r7 = drain(pe.mine(req7))
@@ -201,6 +208,21 @@ want2 = min(
     (chain.hash_to_int(GEN.with_nonce(i).block_hash()), i) for i in range(1000)
 )
 assert not r7.found and (r7.hash_value, r7.nonce) == want2
+assert r7.searched == 1000
+
+# winner path through the sharded tracking sweep's pod fold: a
+# 2-full-span window (no tail) with the genesis winner mid-span-0, so
+# the pipelined loop must report it from the POD sweep, in span order
+req8 = Request(job_id=8, mode=PowMode.TARGET, lower=gn - 200, upper=gn + 311,
+               header=GEN.pack(), target=chain.bits_to_target(0x1D00FFFF))
+r8 = drain(pe.mine(req8))
+assert r8.found and r8.nonce == gn
+assert r8.hash_value == GEN.block_hash_int()
+# and the tail winner path: winner inside the ragged single-chip tail
+req9 = Request(job_id=9, mode=PowMode.TARGET, lower=gn - 300, upper=gn + 30,
+               header=GEN.pack(), target=chain.bits_to_target(0x1D00FFFF))
+r9 = drain(pe.mine(req9))
+assert r9.found and r9.nonce == gn and r9.hash_value == GEN.block_hash_int()
 print("SECTION-OK")
 """,
     # --- single-chip scrypt pipeline on silicon: device batch bit-exact
@@ -282,16 +304,26 @@ def _skip_unless_tpu():
     answer."""
     global _TPU_AVAILABLE, _TPU_PROBE_OUTPUT
     if _TPU_AVAILABLE is None:
-        proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print('BACKEND=' + jax.default_backend())"],
-            env=_tpu_env(), capture_output=True, text=True, timeout=180,
-        )
-        _TPU_PROBE_OUTPUT = f"{proc.stdout}\n{proc.stderr[-1500:]}"
-        _TPU_AVAILABLE = (
-            proc.returncode == 0 and "BACKEND=" in proc.stdout
-            and "BACKEND=cpu" not in proc.stdout
-        )
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print('BACKEND=' + jax.default_backend())"],
+                env=_tpu_env(), capture_output=True, text=True, timeout=180,
+            )
+        except subprocess.TimeoutExpired as exc:
+            # a wedged tunnel can stall libtpu init for many minutes; a
+            # probe that cannot answer in 180 s IS a no-TPU answer, and
+            # it must be CACHED — an uncaught TimeoutExpired here left
+            # _TPU_AVAILABLE unset, so all 10 sections re-probed at
+            # 180 s each and blew the tier-1 suite budget (observed)
+            _TPU_PROBE_OUTPUT = f"backend probe timed out: {exc}"
+            _TPU_AVAILABLE = False
+        else:
+            _TPU_PROBE_OUTPUT = f"{proc.stdout}\n{proc.stderr[-1500:]}"
+            _TPU_AVAILABLE = (
+                proc.returncode == 0 and "BACKEND=" in proc.stdout
+                and "BACKEND=cpu" not in proc.stdout
+            )
     if not _TPU_AVAILABLE:
         # LOUD skip (VERDICT r2 weak #5): a green suite does NOT imply
         # the compiled kernels were verified. Set TPUMINTER_REQUIRE_TPU=1

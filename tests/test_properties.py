@@ -20,6 +20,12 @@ hypothesis can shrink any violation to a minimal schedule:
 import random
 from collections import deque
 
+import pytest
+
+# optional test extra (pyproject [test]); a loud skip beats a collection
+# error when the image lacks it
+pytest.importorskip("hypothesis")
+
 from hypothesis import given, settings, strategies as st
 
 from tpuminter import chain

@@ -312,24 +312,34 @@ def test_romix_walk_uses_one_flat_row_gather_per_step():
         jnp.zeros((b, 32), jnp.uint32)
     )
 
-    def scan_bodies(jx, out):
+    def walk_eqns(jx, out, in_scan=False):
         for eq in jx.eqns:
+            out.append((eq, in_scan))
+            inner_scan = in_scan or eq.primitive.name == "scan"
             for sub in eq.params.values():
                 for item in sub if isinstance(sub, (tuple, list)) else (sub,):
                     if hasattr(item, "jaxpr"):
-                        if eq.primitive.name == "scan":
-                            out.append(item.jaxpr)
-                        scan_bodies(item.jaxpr, out)
+                        walk_eqns(item.jaxpr, out, inner_scan)
         return out
 
-    bodies = scan_bodies(jaxpr.jaxpr, [])
-    assert len(bodies) == 2, f"expected fill+walk scans, got {len(bodies)}"
+    every = walk_eqns(jaxpr.jaxpr, [])
+    scans = [eq for eq, _ in every if eq.primitive.name == "scan"]
+    assert len(scans) == 2, f"expected fill+walk scans, got {len(scans)}"
+    # exactly one gather over ALL eqns (ADVICE r5 #1: counting only
+    # inside scan bodies lets a hoisted gather — or a jaxlib change to
+    # scan/pjit param structure that hides the bodies — pass silently),
+    # and that one gather must live inside a scan (the walk's per-step
+    # row read) and produce whole (B, 32) rows, the measured-optimal
+    # flat layout (23 GB/s; every rejected layout differs here)
+    gathers = [(eq, in_scan) for eq, in_scan in every
+               if eq.primitive.name == "gather"]
     gather_shapes = [
-        [tuple(v.aval.shape) for v in eq.outvars]
-        for body in bodies
-        for eq in body.eqns
-        if eq.primitive.name == "gather"
+        [tuple(v.aval.shape) for v in eq.outvars] for eq, _ in gathers
     ]
-    # exactly one gather in the whole program (the walk's row gather),
-    # producing whole (B, 32) rows
     assert gather_shapes == [[(b, 32)]], gather_shapes
+    assert gathers[0][1], "the row gather was hoisted out of the walk scan"
+    # pretty-print fallback so a jaxlib param-structure change that
+    # breaks the structural walk above still fails loudly here instead
+    # of silently walking zero eqns
+    printed = str(jaxpr)
+    assert printed.count(" gather[") == 1, printed.count(" gather[")

@@ -141,3 +141,63 @@ def test_randomized_matches_bruteforce(seed):
         assert out.searched == upper - lower + 1
         if candidates:
             assert out.best == (1 << 230, min(candidates))
+
+
+# -- pipeline_spans: the generic double-buffer (MIN/scrypt/exact-min) ----
+
+
+def test_pipeline_spans_keeps_depth_in_flight():
+    from tpuminter.search import pipeline_spans
+
+    dispatched = []
+
+    def dispatch(s):
+        dispatched.append(s)
+        return f"h{s}"
+
+    gen = pipeline_spans(range(5), dispatch, depth=2)
+    first = next(gen)
+    # at the first yield exactly one EXTRA dispatch is outstanding:
+    # the consumer blocks on span 0 while span 1 computes
+    assert first == (0, "h0")
+    assert dispatched == [0, 1]
+    rest = list(gen)
+    assert [first] + rest == [(i, f"h{i}") for i in range(5)]
+    assert dispatched == list(range(5))
+
+
+def test_pipeline_spans_depth_one_is_the_synchronous_loop():
+    from tpuminter.search import pipeline_spans
+
+    dispatched = []
+    gen = pipeline_spans(range(3), lambda s: dispatched.append(s) or s, 1)
+    assert next(gen) == (0, 0)
+    assert dispatched == [0]  # nothing speculative at depth 1
+    assert list(gen) == [(1, 1), (2, 2)]
+
+
+def test_pipeline_spans_abandon_leaves_inflight_unresolved():
+    """The Cancel/early-exit contract: a consumer that stops leaves at
+    most ``depth`` handles dispatched beyond what it consumed, and the
+    generator never touches them again (JAX async arrays are simply
+    garbage-collected — same as CandidateSearch's abandoned handles)."""
+    from tpuminter.search import pipeline_spans
+
+    dispatched = []
+    gen = pipeline_spans(range(100), lambda s: dispatched.append(s) or s, 3)
+    for span, handle in gen:
+        assert span == handle
+        if span == 4:
+            gen.close()  # winner found / Cancel landed
+            break
+    # consumed 0..4; speculative dispatches are bounded by depth - 1
+    # beyond the last yielded span (span 4 was yielded right after
+    # span 4 + depth - 1 = 6 was dispatched)
+    assert dispatched == list(range(7))
+
+
+def test_pipeline_spans_rejects_bad_depth():
+    from tpuminter.search import pipeline_spans
+
+    with pytest.raises(ValueError):
+        list(pipeline_spans([1], lambda s: s, 0))

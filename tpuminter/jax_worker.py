@@ -28,6 +28,7 @@ from tpuminter import chain
 from tpuminter.ops import scrypt as scrypt_ops
 from tpuminter.ops import sha256 as ops
 from tpuminter.protocol import PowMode, Request, Result
+from tpuminter.search import pipeline_spans
 from tpuminter.worker import Miner
 
 __all__ = ["JaxMiner"]
@@ -105,12 +106,16 @@ class JaxMiner(Miner):
         batch: int = 1 << 16,
         lanes: Optional[int] = None,
         scrypt_batch: int = 256,
+        depth: int = 2,
     ):
         self.batch = batch
         # scrypt's ROMix scratch is 128 KiB per in-flight nonce, so the
         # memory-hard dialect gets its own (much smaller) batch size:
         # scrypt_batch × 128 KiB of V lives on device per step
         self.scrypt_batch = scrypt_batch
+        # device calls kept in flight by the pipelined loops (scrypt):
+        # the memory cost of depth 2 is one extra batch of V in flight
+        self.depth = depth
         # scheduler hint: ask the coordinator for chunks a few batches deep
         self.lanes = lanes if lanes is not None else max(1, (batch * 4) // 16_384)
 
@@ -219,37 +224,64 @@ class JaxMiner(Miner):
 
     def _mine_scrypt(self, req: Request) -> Iterator[Optional[Result]]:
         """Memory-hard dialect (BASELINE.json:11): batched scrypt with
-        the header words as runtime inputs — one compile total."""
+        the header words as runtime inputs — one compile total. Batches
+        are double-buffered ``depth`` deep across segment boundaries
+        (``search.pipeline_spans`` — VERDICT r5 weak #2: the per-batch
+        ``bool(found)`` sync serialized the ~100 ms tunnel RTT with the
+        ~1 s device step). Batches resolve in order, so the early exit's
+        first-winner semantics are unchanged; a winner just leaves up to
+        ``depth - 1`` in-flight batches unresolved (free for JAX async
+        arrays)."""
         assert req.target is not None
         target_words = jnp.asarray(ops.target_to_words(req.target))
+
+        def spans():
+            for hdr76, base_g, lo, hi in self._scrypt_segments(req):
+                hw = jnp.asarray(scrypt_ops.header_to_words(hdr76))
+                for _, valid, nonces in self._batches(lo, hi, self.scrypt_batch):
+                    yield hw, base_g, valid, nonces
+
+        def dispatch(span):
+            hw, _, _, nonces = span
+            u32 = jnp.asarray(nonces.astype(np.uint32))
+            found, first, midx, min_digest, first_digest = _scrypt_step(
+                hw, u32, target_words
+            )
+            # one device array per batch (cf. search.pack_handle):
+            # [found, first, midx, min_digest×8, first_digest×8]
+            return jnp.concatenate([
+                jnp.stack([
+                    found.astype(jnp.uint32),
+                    first.astype(jnp.uint32),
+                    midx.astype(jnp.uint32),
+                ]),
+                min_digest, first_digest,
+            ])
+
         best: Optional[Tuple[int, int]] = None  # (hash, global index)
         searched = 0
-        for hdr76, base_g, lo, hi in self._scrypt_segments(req):
-            hw = jnp.asarray(scrypt_ops.header_to_words(hdr76))
-            for start, valid, nonces in self._batches(lo, hi, self.scrypt_batch):
-                u32 = jnp.asarray(nonces.astype(np.uint32))
-                found, first, midx, min_digest, first_digest = _scrypt_step(
-                    hw, u32, target_words
+        for (_, base_g, valid, nonces), handle in pipeline_spans(
+            spans(), dispatch, depth=self.depth
+        ):
+            row = np.asarray(handle)
+            if int(row[0]):
+                first = int(row[1])
+                g = base_g | int(nonces[first])
+                h = ops.digest_to_int(row[11:19])
+                yield Result(
+                    req.job_id, req.mode, g, h, found=True,
+                    searched=searched + min(first + 1, valid),
+                    chunk_id=req.chunk_id,
                 )
-                if bool(found):
-                    first = int(first)
-                    g = base_g | int(nonces[first])
-                    h = ops.digest_to_int(np.asarray(first_digest))
-                    yield Result(
-                        req.job_id, req.mode, g, h, found=True,
-                        searched=searched + min(first + 1, valid),
-                        chunk_id=req.chunk_id,
-                    )
-                    return
-                midx = int(midx)
-                cand = (
-                    ops.digest_to_int(np.asarray(min_digest)),
-                    base_g | int(nonces[midx]),
-                )
-                if best is None or cand < best:
-                    best = cand
-                searched += valid
-                yield None
+                return
+            cand = (
+                ops.digest_to_int(row[3:11]),
+                base_g | int(nonces[int(row[2])]),
+            )
+            if best is None or cand < best:
+                best = cand
+            searched += valid
+            yield None
         yield Result(
             req.job_id, req.mode, best[1], best[0],
             found=best[0] <= req.target,

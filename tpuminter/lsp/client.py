@@ -55,22 +55,37 @@ class LspClient:
         loop = asyncio.get_running_loop()
         self._connect_waiter = loop.create_future()
         connect_frame = encode(Frame(MsgType.CONNECT, 0, 0))
-        for _ in range(self._params.epoch_limit):
-            self._endpoint.send(connect_frame, self._server_addr)
-            try:
-                conn_id = await asyncio.wait_for(
-                    asyncio.shield(self._connect_waiter),
+        try:
+            for _ in range(self._params.epoch_limit):
+                self._endpoint.send(connect_frame, self._server_addr)
+                # NOT wait_for(shield(...)): on this Python vintage
+                # wait_for SWALLOWS an external Task.cancel() that races
+                # the ack (bpo-42130 — the inner future completing in
+                # the same tick wins and the CancelledError is silently
+                # dropped), leaving a caller that cancelled us
+                # mid-connect with a live, uncancellable client parked
+                # in read() forever (observed: tests/test_fuzz.py
+                # teardown wedging on replacement actors). asyncio.wait
+                # never consumes a cancellation.
+                await asyncio.wait(
+                    [self._connect_waiter],
                     timeout=self._params.epoch_seconds,
                 )
-                break
-            except asyncio.TimeoutError:
-                continue
-        else:
+                if self._connect_waiter.done():
+                    conn_id = self._connect_waiter.result()
+                    break
+            else:
+                raise lsp.LspConnectError(
+                    f"no connect-ack from {host}:{port} after "
+                    f"{self._params.epoch_limit} epochs"
+                )
+        except BaseException:
+            # any failed dial — epoch exhaustion OR a cancellation now
+            # propagating thanks to the wait() above — must release the
+            # bound UDP socket and its datagram callback, or every
+            # cancelled connect leaks one endpoint for process life
             self._endpoint.close()
-            raise lsp.LspConnectError(
-                f"no connect-ack from {host}:{port} after "
-                f"{self._params.epoch_limit} epochs"
-            )
+            raise
         self._conn = ConnState(
             conn_id,
             self._params,

@@ -12,6 +12,7 @@ Joining the coordinator like any other miner.
 
 from tpuminter.parallel.mesh import (
     build_candidate_sweep,
+    build_exact_sweep_pallas,
     build_min_fold,
     build_min_sweep_pallas,
     build_scrypt_sweep,
@@ -24,6 +25,7 @@ __all__ = [
     "build_target_sweep",
     "build_min_fold",
     "build_min_sweep_pallas",
+    "build_exact_sweep_pallas",
     "build_candidate_sweep",
     "build_scrypt_sweep",
 ]

@@ -57,18 +57,37 @@ def init_from_env() -> bool:
 
     addr = os.environ.get(ENV_ADDR)
     if addr is not None:
-        jax.distributed.initialize(
-            addr,
+        try:
+            # jax 0.4.x: CPU cross-process collectives exist but are off
+            # by default — without this, the first shard_map collective
+            # dies with "Multiprocess computations aren't implemented on
+            # the CPU backend". Newer jax defaults to gloo and drops the
+            # knob, hence the guard.
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except (AttributeError, ValueError):
+            pass
+        kwargs = dict(
             num_processes=int(os.environ[ENV_NPROCS]),
             process_id=int(os.environ[ENV_PID]),
+        )
+        try:
             # an orphaned process (its peer crashed mid-collective) must
             # self-terminate promptly — the coordinator has already
             # requeued the pod's chunk, so a hung follower is pure leak;
             # jax's default 100 s is tuned for flaky DCN, not localhost
-            heartbeat_timeout_seconds=int(
-                os.environ.get("TPUMINTER_HEARTBEAT_S", "30")
-            ),
-        )
+            jax.distributed.initialize(
+                addr,
+                heartbeat_timeout_seconds=int(
+                    os.environ.get("TPUMINTER_HEARTBEAT_S", "30")
+                ),
+                **kwargs,
+            )
+        except TypeError:
+            # older jax (0.4.x): no heartbeat knob — the runtime's baked
+            # defaults govern orphan teardown instead (slower detection,
+            # same cascade; tests deriving bounds from TPUMINTER_HEARTBEAT_S
+            # must tolerate the default-timeout regime)
+            jax.distributed.initialize(addr, **kwargs)
     return jax.process_count() > 1
 
 
@@ -78,29 +97,55 @@ def is_leader() -> bool:
     return jax.process_index() == 0
 
 
-def broadcast_flag(value: Optional[int] = None) -> int:
-    """Broadcast one small int from the leader (followers pass None)."""
+#: bytes per broadcast collective. EVERY broadcast uses this one fixed
+#: shape — one compiled computation, one collective channel — so
+#: consecutive broadcasts can never be cross-matched by the transport.
+#: (Observed on Gloo/jaxlib-0.4.37: a 4-byte flag collective and a
+#: padded payload collective got matched to each other under load —
+#: ``gloo::EnforceNotMet: op.preamble.length <= op.nbytes, 128 vs 4`` —
+#: because separately-compiled CPU collectives can share a channel tag.
+#: Fixed-shape frames make the stream self-synchronizing by
+#: construction; a 4 KiB frame per generator step is noise against the
+#: ≥100 ms device spans the steps gate.)
+FRAME = 4096
+_WORDS = FRAME // 4  # frames travel as int32 words: the broadcast's
+# underlying psum would promote uint8 to int32 anyway (jnp.sum), which
+# silently reshaped/corrupted byte frames — int32 in, int32 out is the
+# dtype-stable contract
+
+
+def _bcast(words: np.ndarray) -> np.ndarray:
     from jax.experimental import multihost_utils as mhu
 
-    v = np.int32(value if value is not None else 0)
-    return int(mhu.broadcast_one_to_all(v))
+    return np.asarray(mhu.broadcast_one_to_all(words)).astype(np.int32)
+
+
+def broadcast_flag(value: Optional[int] = None) -> int:
+    """Broadcast one small int from the leader (followers pass None)."""
+    buf = np.zeros(_WORDS, dtype=np.int32)
+    if value is not None:
+        buf[0] = np.int32(value)
+    return int(_bcast(buf)[0])
 
 
 def broadcast_bytes(data: Optional[bytes] = None) -> bytes:
     """Broadcast a byte string from the leader (followers pass None).
 
-    Length travels first so every process agrees on the (power-of-two
-    padded, to bound the jit cache) payload shape before the payload
-    collective runs.
-    """
-    from jax.experimental import multihost_utils as mhu
-
+    Length travels first (its own frame) so every process agrees on the
+    frame count; the payload then streams in whole :data:`FRAME`-byte
+    chunks."""
     n = broadcast_flag(len(data) if data is not None else 0)
     if n == 0:
         return b""
-    size = 1 << (n - 1).bit_length()
-    buf = np.zeros(size, dtype=np.uint8)
-    if data is not None:
-        buf[:n] = np.frombuffer(data, dtype=np.uint8)
-    out = np.asarray(mhu.broadcast_one_to_all(buf))
-    return out[:n].tobytes()
+    out = bytearray()
+    for off in range(0, n, FRAME):
+        take = min(FRAME, n - off)
+        buf = np.zeros(_WORDS, dtype=np.int32)
+        if data is not None:
+            padded = np.zeros(FRAME, dtype=np.uint8)
+            padded[:take] = np.frombuffer(
+                data[off:off + take], dtype=np.uint8
+            )
+            buf[:] = padded.view(np.int32)
+        out += _bcast(buf).tobytes()[:take]
+    return bytes(out)

@@ -32,9 +32,9 @@ from __future__ import annotations
 
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable, Iterator, List, Optional, Tuple
+from typing import Callable, Iterable, Iterator, List, Optional, Tuple
 
-__all__ = ["CandidateSearch", "SearchOutcome"]
+__all__ = ["CandidateSearch", "SearchOutcome", "pipeline_spans"]
 
 #: sweep(base, n) -> opaque handle (asynchronous dispatch)
 SweepFn = Callable[[int, int], object]
@@ -62,6 +62,40 @@ def resolve_handle(handle) -> Tuple[int, int]:
 
     arr = np.asarray(handle)
     return int(arr[0]), int(arr[1])
+
+
+def pipeline_spans(
+    spans: Iterable, dispatch: Callable[..., object], depth: int = 2
+) -> Iterator[Tuple[object, object]]:
+    """Double-buffer a host loop over device calls: the generic form of
+    the ``CandidateSearch`` depth-``k`` in-flight trick, for dialects
+    with no early-exit bookkeeping to manage (MIN, scrypt, exact-min).
+
+    Yields ``(span, handle)`` pairs in dispatch order with up to
+    ``depth`` dispatches outstanding when the caller blocks on a
+    handle — so the ~100 ms per-call host/tunnel dispatch latency
+    overlaps device compute instead of serializing with it (the same
+    0.73 → ≥1.0 GH/s step PERF.md records for the TARGET pipeline).
+    ``dispatch(span)`` must be non-blocking (JAX async dispatch is);
+    the caller resolves each yielded handle (``np.asarray``/``int``),
+    which is the only sync point.
+
+    Early exit: a caller that stops consuming (found a winner,
+    Cancel abandoned the generator) simply leaves the in-flight
+    handles unresolved — free for JAX async arrays (same contract as
+    ``CandidateSearch``'s abandoned handles). Cancel latency therefore
+    stays bounded by ONE span resolution: the role loop's yield points
+    sit between resolved spans, exactly as in the synchronous loop.
+    """
+    if depth < 1:
+        raise ValueError("depth must be >= 1")
+    inflight: deque = deque()
+    for span in spans:
+        inflight.append((span, dispatch(span)))
+        if len(inflight) >= depth:
+            yield inflight.popleft()
+    while inflight:
+        yield inflight.popleft()
 
 
 @dataclass
