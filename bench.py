@@ -523,6 +523,73 @@ def bench_cold_start(slab: int = SLAB) -> dict:
     return {"time_to_block_cold_cached_ms": round(cold["ms"], 1)}
 
 
+def bench_control_plane(fleets=(8, 64), duration: float = 5.0) -> dict:
+    """Control-plane throughput/latency (scripts/loadgen.py): a REAL
+    coordinator + N instant miners + M clients over the real LSP/UDP
+    stack on loopback. CPU-only by construction, so it captures even
+    when the TPU tunnel is down — the first benchmark of the scheduler
+    path the ROADMAP north-star actually runs through. The fleet-64
+    figures are the headline (``control_plane_*`` fields); every fleet
+    size lands under ``control_plane_fleet<N>_*``."""
+    import asyncio
+    import os as _os
+    import sys as _sys
+
+    _sys.path.insert(
+        0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                         "scripts"),
+    )
+    import loadgen
+
+    out = {}
+    for fleet in fleets:
+        m = asyncio.run(loadgen.run_load(fleet, 4, duration))
+        out[f"control_plane_fleet{fleet}_results_per_s"] = m["results_per_s"]
+        out[f"control_plane_fleet{fleet}_assigns_per_s"] = m["assigns_per_s"]
+        out[f"control_plane_fleet{fleet}_p50_ms"] = m["p50_ms"]
+        out[f"control_plane_fleet{fleet}_p99_ms"] = m["p99_ms"]
+        out[f"control_plane_fleet{fleet}_max_stall_ms"] = m["max_stall_ms"]
+        out[f"control_plane_fleet{fleet}_frames_sent"] = m["frames_sent"]
+        out[f"control_plane_fleet{fleet}_acks_coalesced"] = m["acks_coalesced"]
+    biggest = max(fleets)
+    out["control_plane_results_per_s"] = out[
+        f"control_plane_fleet{biggest}_results_per_s"
+    ]
+    out["control_plane_assigns_per_s"] = out[
+        f"control_plane_fleet{biggest}_assigns_per_s"
+    ]
+    out["control_plane_p99_assign_to_result_ms"] = out[
+        f"control_plane_fleet{biggest}_p99_ms"
+    ]
+    return out
+
+
+def bench_native(seconds: float = 2.0) -> dict:
+    """Measured native C++ double-SHA rate (README's backend table row;
+    BASELINE.md quoted 1.84 MH/s on this host). Absent .so → empty."""
+    from tpuminter import native_verify
+
+    if not native_verify.available():
+        return {}
+    from tpuminter.native_worker import NativeMiner
+    from tpuminter.protocol import PowMode, Request
+
+    miner = NativeMiner()
+    hdr = chain.GENESIS_HEADER.pack()
+    done = 0
+    span = 1 << 18
+    t0 = time.perf_counter()
+    jid = 0
+    while time.perf_counter() - t0 < seconds:
+        jid += 1
+        req = Request(job_id=jid, mode=PowMode.TARGET, lower=done & 0xFFFF,
+                      upper=(done & 0xFFFF) + span - 1, header=hdr, target=1)
+        for item in miner.mine(req):
+            pass
+        done += span
+    return {"native_mhs": round(done / (time.perf_counter() - t0) / 1e6, 3)}
+
+
 def bench_jnp(batch: int, secs: float = 1.0) -> float:
     template = ops.header_template(chain.GENESIS_HEADER.pack())
     target_words = jnp.asarray(ops.target_to_words(1))
@@ -550,9 +617,19 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
         rate = bench_jnp(1 << 14)
         extra["scrypt_khs_per_chip"] = round(bench_scrypt(64, 2) / 1e3, 3)
+        extra.update(bench_control_plane(fleets=(8,), duration=1.5))
+        extra.update(bench_native(seconds=0.5))
     elif jax.default_backend() == "cpu":
+        # the TPU tunnel is down and jax silently fell back to CPU: say
+        # so LOUDLY instead of publishing CPU numbers that look like a
+        # regression (the PR 1 session lost its capture to exactly
+        # this), and still capture every CPU-measurable section —
+        # control plane, native core, jnp/scrypt reference rates.
+        extra["tpu_unreachable"] = True
         rate = bench_jnp(1 << 14)
         extra["scrypt_khs_per_chip"] = round(bench_scrypt(64, 2) / 1e3, 3)
+        extra.update(bench_control_plane())
+        extra.update(bench_native())
     else:
         # persistent compilation cache, same as the worker CLI: the
         # in-process first compile seeds it; bench_cold_start then
@@ -574,6 +651,10 @@ def main() -> None:
         extra.update(bench_pod_scrypt())
         extra.update(bench_pod_exact_min())
         extra.update(bench_cold_start())
+        # CPU-side sections ride along on TPU captures too: the control
+        # plane and native core are part of the system's headline
+        extra.update(bench_control_plane())
+        extra.update(bench_native())
     ghs = rate / 1e9
     print(
         json.dumps(

@@ -151,6 +151,44 @@ int sha256d_search(const uint8_t* header76, uint32_t lower, uint32_t upper,
   return 0;
 }
 
+// Batch double-SHA-256 of `count` independent (header76, nonce) pairs:
+// the coordinator-side verification entry point. Unlike sha256d_search
+// (one header, many nonces) each item here may be a different header —
+// a verification burst mixes jobs and rolled extranonce segments — so
+// the midstate is computed per item: 4 compressions each, the same
+// work a worker's claim cost to make honest.
+//
+// headers76: count × 76 bytes, packed back to back.
+// out_hash:  count × 8 msb-first u32 hash VALUE words (same convention
+//            as sha256d_search's out_hash).
+void sha256d_hash_batch(const uint8_t* headers76, const uint32_t* nonces,
+                        uint64_t count, uint32_t* out_hash) {
+  for (uint64_t i = 0; i < count; ++i) {
+    const uint8_t* hdr = headers76 + 76 * i;
+    uint32_t mid[8];
+    std::memcpy(mid, H0, sizeof(mid));
+    uint32_t w[16];
+    for (int j = 0; j < 16; ++j) w[j] = load_be(hdr + 4 * j);
+    compress(mid, w);
+    uint32_t tail[16] = {0};
+    tail[0] = load_be(hdr + 64);
+    tail[1] = load_be(hdr + 68);
+    tail[2] = load_be(hdr + 72);
+    tail[3] = bswap(nonces[i]);
+    tail[4] = 0x80000000u;
+    tail[15] = 640;
+    compress(mid, tail);
+    uint32_t second[16] = {0};
+    std::memcpy(second, mid, 8 * sizeof(uint32_t));
+    second[8] = 0x80000000u;
+    second[15] = 256;
+    uint32_t st2[8];
+    std::memcpy(st2, H0, sizeof(st2));
+    compress(st2, second);
+    for (int j = 0; j < 8; ++j) out_hash[8 * i + j] = bswap(st2[7 - j]);
+  }
+}
+
 // Toy dialect (reference parity): minimize the 64-bit fold (first 8
 // digest bytes, big-endian) of SHA-256(data ‖ nonce_be8) over
 // [lower, upper]. Writes the argmin nonce and fold value.

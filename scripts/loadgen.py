@@ -1,0 +1,295 @@
+#!/usr/bin/env python
+"""Control-plane load generator: the first benchmark of the scheduler path.
+
+Every data-plane number in BENCH_r0x measures hashes/s; nothing measured
+the loop the ROADMAP north-star actually runs through at fleet scale —
+coordinator message handling, dispatch, host verification, and the LSP
+stack under a sustained assign/result churn. This harness drives a REAL
+:class:`~tpuminter.coordinator.Coordinator` over the REAL LSP/UDP stack
+on loopback with N *instant* miners (answer every Assign immediately
+with a verifiable Result — zero mining time, so the measurement is pure
+control plane) and M closed-loop clients, and reports:
+
+- ``results_per_s``   — chunk Results accepted by the coordinator
+- ``assigns_per_s``   — chunk dispatches written by the coordinator
+- ``p50_ms``/``p99_ms`` — assign→result round trip (dispatch write to
+  accepted Result, ``Coordinator.latencies``)
+- ``max_stall_ms``    — worst event-loop stall observed by a 1 ms
+  sampler; heartbeats/epochs miss deadlines iff the loop stalls, so
+  this bounds "no heartbeat deadline missed"
+- ``frames_sent``/``frames_received``/``acks_coalesced`` — datagram and
+  ack-coalescing counters at the coordinator's transport seam
+
+All miners/clients are in-process asyncio tasks (the same way the e2e
+suite fakes multi-node on localhost), so the figure is a whole-stack
+number: both ends' CPU shares one core, exactly like the CI host.
+
+CLI:  ``python scripts/loadgen.py [--miners N] [--clients M]
+[--duration S] [--smoke] [--json]``.  ``--smoke`` runs a short fleet-64
+burst and exits nonzero on any event-loop stall above one FAST epoch or
+any miner declared lost — the tier-1 liveness gate
+(tests/test_control_plane.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import statistics
+import sys
+import time
+from typing import Optional
+
+# allow `python scripts/loadgen.py` from a source checkout
+sys.path.insert(0, __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__))
+))
+
+from tpuminter import chain  # noqa: E402
+from tpuminter.coordinator import Coordinator  # noqa: E402
+from tpuminter.lsp import LspClient, LspConnectionLost, Params  # noqa: E402
+from tpuminter.lsp.params import FAST  # noqa: E402
+from tpuminter.protocol import (  # noqa: E402
+    Assign,
+    Cancel,
+    Join,
+    PowMode,
+    Request,
+    Result,
+    Setup,
+    decode_msg,
+    encode_msg,
+)
+
+
+async def _instant_miner(port: int, params: Params) -> None:
+    """Join, then answer every Assign instantly with a *verifiable*
+    Result (the real toy hash of the range's first nonce). The
+    coordinator's per-result verification cost is therefore the
+    production cost; the miner's own cost is one host SHA-256."""
+    w = await LspClient.connect("127.0.0.1", port, params)
+    w.write(encode_msg(Join(backend="instant", lanes=1)))
+    templates = {}
+
+    def handle(raw: bytes) -> None:
+        msg = decode_msg(raw)
+        if isinstance(msg, Setup):
+            templates[msg.request.job_id] = msg.request
+        elif isinstance(msg, Cancel):
+            templates.pop(msg.job_id, None)
+        elif isinstance(msg, Assign):
+            req = templates.get(msg.job_id)
+            if req is None:
+                return
+            w.write(encode_msg(Result(
+                msg.job_id, req.mode, nonce=msg.lower,
+                hash_value=chain.toy_hash(req.data, msg.lower),
+                found=True, searched=msg.upper - msg.lower + 1,
+                chunk_id=msg.chunk_id,
+            )))
+
+    try:
+        while True:
+            raw = await w.read()
+            # drain the delivered burst without a task wakeup per message
+            while raw is not None:
+                handle(raw)
+                raw = (
+                    w.read_nowait() if hasattr(w, "read_nowait") else None
+                )
+    except (LspConnectionLost, asyncio.CancelledError):
+        pass
+    finally:
+        await w.close(drain_timeout=0.2)
+
+
+async def _client_loop(port: int, params: Params, cid: int, upper: int,
+                       counter: dict) -> None:
+    """Closed-loop client: submit a MIN job, await its Result, repeat —
+    one LSP connection for the whole run (the reference's one-shot
+    connect/submit would measure dial latency, not the scheduler)."""
+    c = await LspClient.connect("127.0.0.1", port, params)
+    try:
+        jid = 0
+        while True:
+            jid += 1
+            c.write(encode_msg(Request(
+                job_id=jid, mode=PowMode.MIN, lower=0, upper=upper,
+                data=b"loadgen-%d-%d" % (cid, jid),
+            )))
+            while True:
+                msg = decode_msg(await c.read())
+                if isinstance(msg, Result) and msg.job_id == jid:
+                    break
+            counter["jobs"] += 1
+    except (LspConnectionLost, asyncio.CancelledError):
+        pass
+    finally:
+        await c.close(drain_timeout=0.2)
+
+
+async def _stall_sampler(sample: float, out: dict) -> None:
+    """Record the worst event-loop stall: a sleep(d) that wakes late by
+    s means every timer (epoch ticks, heartbeats) was delayed by s."""
+    loop = asyncio.get_running_loop()
+    while True:
+        t0 = loop.time()
+        await asyncio.sleep(sample)
+        late = loop.time() - t0 - sample
+        if late > out["max_stall"]:
+            out["max_stall"] = late
+
+
+async def run_load(
+    n_miners: int = 8,
+    n_clients: int = 4,
+    duration: float = 3.0,
+    *,
+    chunk_size: int = 1024,
+    chunks_per_job: Optional[int] = None,
+    params: Params = FAST,
+    warmup: float = 0.5,
+) -> dict:
+    """Drive the fleet for ``duration`` seconds (after ``warmup``) and
+    return the metrics dict described in the module docstring."""
+    coord = await Coordinator.create(params=params, chunk_size=chunk_size)
+    serve = asyncio.ensure_future(coord.serve())
+    # jobs long enough that every miner stays busy between completions
+    if chunks_per_job is None:
+        chunks_per_job = max(8, 4 * n_miners)
+    upper = chunk_size * chunks_per_job - 1
+    lost_events = {"n": 0}
+    # count loss events at the server seam: a healthy loopback run must
+    # declare nobody dead (a stalled loop shows up here first)
+    orig_handle_lost = coord._server._handle_lost
+
+    def counting_handle_lost(conn_id: int) -> None:
+        lost_events["n"] += 1
+        orig_handle_lost(conn_id)
+
+    coord._server._handle_lost = counting_handle_lost
+
+    miners = [
+        asyncio.ensure_future(_instant_miner(coord.port, params))
+        for _ in range(n_miners)
+    ]
+    counter = {"jobs": 0}
+    clients = [
+        asyncio.ensure_future(
+            _client_loop(coord.port, params, i, upper, counter)
+        )
+        for i in range(n_clients)
+    ]
+    stall = {"max_stall": 0.0}
+    sampler = asyncio.ensure_future(_stall_sampler(0.001, stall))
+    try:
+        await asyncio.sleep(warmup)
+        ep = coord.server.endpoint
+        t0 = time.monotonic()
+        chunks0 = coord._next_chunk_id
+        # churn-proof cumulative counters (per-miner sums would lose a
+        # lost miner's whole history from the delta)
+        results0 = (
+            coord.stats["results_accepted"] + coord.stats["results_rejected"]
+        )
+        rejected0 = coord.stats["results_rejected"]
+        lat_seen0 = len(coord.latencies)
+        sent0, recv0 = ep.sent, ep.received
+        jobs0 = counter["jobs"]
+        stall["max_stall"] = 0.0  # warmup stalls (connect burst) excluded
+        await asyncio.sleep(duration)
+        dt = time.monotonic() - t0
+        assigns = coord._next_chunk_id - chunks0
+        results = (
+            coord.stats["results_accepted"] + coord.stats["results_rejected"]
+            - results0
+        )
+        lats = list(coord.latencies)[lat_seen0:] or [0.0]
+        lats_ms = sorted(1e3 * x for x in lats)
+        ack_stats = getattr(coord.server, "ack_stats", lambda: {})()
+        return {
+            "fleet": n_miners,
+            "clients": n_clients,
+            "duration_s": round(dt, 3),
+            "results_per_s": round(results / dt, 1),
+            "assigns_per_s": round(assigns / dt, 1),
+            "jobs_per_s": round((counter["jobs"] - jobs0) / dt, 2),
+            "p50_ms": round(statistics.median(lats_ms), 3),
+            "p99_ms": round(
+                lats_ms[max(0, int(len(lats_ms) * 0.99) - 1)], 3
+            ),
+            "max_stall_ms": round(stall["max_stall"] * 1e3, 3),
+            "frames_sent": ep.sent - sent0,
+            "frames_received": ep.received - recv0,
+            "acks_sent": ack_stats.get("acks_sent", 0),
+            "acks_coalesced": ack_stats.get("acks_coalesced", 0),
+            "miners_lost": lost_events["n"],
+            "results_rejected": coord.stats["results_rejected"] - rejected0,
+        }
+    finally:
+        sampler.cancel()
+        for t in clients + miners:
+            t.cancel()
+        await asyncio.gather(*clients, *miners, return_exceptions=True)
+        serve.cancel()
+        await asyncio.gather(serve, return_exceptions=True)
+        await coord.close()
+
+
+def smoke_check(metrics: dict, params: Params = FAST) -> list:
+    """The liveness assertions behind ``--smoke`` (returned as a list of
+    violation strings so tests can show all of them at once): the
+    coordinator must sustain the fleet with zero loss events, make real
+    progress, and never stall the event loop past one epoch — the bound
+    past which heartbeats start missing their deadlines."""
+    bad = []
+    if metrics["results_per_s"] <= 0:
+        bad.append(f"no results accepted: {metrics}")
+    if metrics["miners_lost"] > 0:
+        bad.append(
+            f"{metrics['miners_lost']} connection(s) declared lost on a "
+            f"healthy loopback fleet"
+        )
+    if metrics["max_stall_ms"] >= params.epoch_millis:
+        bad.append(
+            f"event-loop stall {metrics['max_stall_ms']:.1f} ms >= one "
+            f"{params.epoch_millis} ms epoch: heartbeat deadlines missed"
+        )
+    return bad
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="tpuminter control-plane load generator"
+    )
+    parser.add_argument("--miners", type=int, default=8)
+    parser.add_argument("--clients", type=int, default=4)
+    parser.add_argument("--duration", type=float, default=3.0)
+    parser.add_argument("--chunk-size", type=int, default=1024)
+    parser.add_argument(
+        "--smoke", action="store_true",
+        help="fleet-64 burst with liveness assertions: exit 1 on any "
+        "event-loop stall >= one epoch or any lost connection",
+    )
+    parser.add_argument("--json", action="store_true", help="JSON output")
+    args = parser.parse_args(argv)
+    if args.smoke:
+        args.miners, args.clients = 64, 4
+        args.duration = min(args.duration, 2.0)
+    metrics = asyncio.run(run_load(
+        args.miners, args.clients, args.duration,
+        chunk_size=args.chunk_size,
+    ))
+    print(json.dumps(metrics) if args.json else
+          "\n".join(f"{k}: {v}" for k, v in metrics.items()))
+    if args.smoke:
+        violations = smoke_check(metrics)
+        for v in violations:
+            print(f"SMOKE FAIL: {v}", file=sys.stderr)
+        return 1 if violations else 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

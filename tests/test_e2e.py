@@ -1015,6 +1015,73 @@ def test_client_sees_disconnected_when_coordinator_dies_mid_job():
 
 
 # ---------------------------------------------------------------------------
+# reference-default LSP params (VERDICT r5 next #6: the last true
+# coverage hole — every scenario above runs on FAST millisecond epochs)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_reference_default_params_survive_miner_death():
+    """One full scenario on ``Params()`` DEFAULTS (epoch_limit 5,
+    epoch_millis 2000, window_size 1 — the canonical reference
+    vintage): coordinator + 2 miners + client, one miner hard-killed
+    mid-job. Death detection takes 5 × 2 s of real time here, which is
+    exactly the point — the window-1, seconds-scale regime is a
+    different operating point of the same machine (stop-and-wait sends,
+    heartbeat pacing, loss horizon) and nothing above exercises it."""
+
+    async def scenario():
+        defaults = Params()
+        coord = await Coordinator.create(params=defaults)
+        serve = asyncio.ensure_future(coord.serve())
+        miners = [
+            asyncio.ensure_future(run_miner(
+                "127.0.0.1", coord.port, CpuMiner(batch=2048),
+                params=defaults,
+            ))
+            for _ in range(2)
+        ]
+        try:
+            await asyncio.sleep(1.0)  # both Joins land
+            assert len(coord.worker_stats()) == 2
+            data = b"reference defaults"
+            req = Request(job_id=1, mode=PowMode.MIN, lower=0,
+                          upper=600_000, data=data)
+            job = asyncio.ensure_future(submit(
+                "127.0.0.1", coord.port, req, params=defaults
+            ))
+            # kill a miner once BOTH demonstrably hold chunks (so the
+            # victim's death provably costs an in-flight chunk)
+            for _ in range(400):
+                stats = coord.worker_stats()
+                if len(stats) == 2 and all(
+                    s["busy"] for s in stats.values()
+                ):
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise AssertionError("miners never both went busy")
+            assert not job.done()
+            victim = miners[0]
+            victim.cancel()
+            await asyncio.gather(victim, return_exceptions=True)
+            # 10 s loss horizon + remaining mining, with slack for the
+            # window-1 message pacing
+            result = await asyncio.wait_for(job, 120.0)
+            assert (result.hash_value, result.nonce) == brute_min(
+                data, 0, 600_000
+            )
+            assert coord.stats["chunks_requeued"] >= 1
+        finally:
+            for m in miners:
+                m.cancel()
+            serve.cancel()
+            await asyncio.gather(*miners, serve, return_exceptions=True)
+            await coord.close()
+
+    run(scenario(), timeout=180.0)
+
+
+# ---------------------------------------------------------------------------
 # long-lived coordinator soak (VERDICT r4 missing #3)
 # ---------------------------------------------------------------------------
 

@@ -144,3 +144,38 @@ def test_native_through_cluster(native_miner):
             await cluster.close()
 
     run(scenario())
+
+
+def test_batch_verify_matches_hashlib(native_miner):
+    """The coordinator's verification entry point (sha256d_hash_batch,
+    bound via tpuminter.native_verify): hash values for a mixed batch
+    of (header76, nonce) pairs — different headers per item, the
+    verification-burst shape — must equal hashlib's double-SHA exactly,
+    genesis winner included."""
+    import random
+
+    from tpuminter import native_verify
+
+    assert native_verify.available()  # the fixture built the library
+    rng = random.Random(7)
+    headers = [GEN.pack()[:76]]
+    nonces = [GEN.nonce]
+    for i in range(33):
+        hdr = GEN.with_nonce(0).with_merkle_root(
+            bytes(rng.randrange(256) for _ in range(32))
+        ).pack()[:76]
+        headers.append(hdr)
+        nonces.append(rng.randrange(1 << 32))
+    want = [
+        chain.hash_to_int(chain.dsha256(h + struct.pack("<I", n)))
+        for h, n in zip(headers, nonces)
+    ]
+    assert native_verify.dsha256_header_batch(headers, nonces) == want
+    # the count=1 path the per-result verifier uses
+    assert native_verify.dsha256_header(headers[0], nonces[0]) == want[0]
+    assert want[0] == GEN.block_hash_int()
+    # shape errors are loud, not silent corruption
+    with pytest.raises(ValueError):
+        native_verify.dsha256_header_batch(headers[:2], nonces[:1])
+    with pytest.raises(ValueError):
+        native_verify.dsha256_header_batch([b"short"], [1])

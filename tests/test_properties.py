@@ -30,7 +30,14 @@ from hypothesis import given, settings, strategies as st
 
 from tpuminter import chain
 from tpuminter.lsp.connection import FRAGMENT_SIZE, ConnState
-from tpuminter.lsp.message import MAX_PAYLOAD, Frame, MsgType, decode, encode
+from tpuminter.lsp.message import (
+    MAX_PAYLOAD,
+    Frame,
+    MsgType,
+    decode,
+    decode_all,
+    encode,
+)
 from tpuminter.lsp.params import Params
 from tpuminter.protocol import (
     Assign,
@@ -80,6 +87,42 @@ def test_codec_rejects_any_truncation(frame, keep):
 
 
 # ---------------------------------------------------------------------------
+# bundled datagrams (decode_all): several frames per datagram
+# ---------------------------------------------------------------------------
+
+@settings(max_examples=80)
+@given(st.lists(frames, min_size=1, max_size=5))
+def test_bundle_roundtrip(frs):
+    wire = b"".join(bytes(encode(f)) for f in frs)
+    assert list(decode_all(wire)) == frs
+
+
+@settings(max_examples=80)
+@given(st.lists(frames, min_size=1, max_size=4), st.data())
+def test_bundle_corruption_yields_only_a_clean_prefix(frs, data):
+    """A 1-byte flip anywhere in a bundled datagram may unframe
+    everything after it, but what DOES decode must be an exact prefix
+    of the original frames — corruption can only look like loss, never
+    like different frames (CRC-32 per frame)."""
+    wire = bytearray(b"".join(bytes(encode(f)) for f in frs))
+    i = data.draw(st.integers(0, len(wire) - 1))
+    wire[i] ^= data.draw(st.integers(1, 255))
+    got = list(decode_all(bytes(wire)))
+    assert len(got) < len(frs) or got != frs  # the flip cost something
+    assert got == frs[: len(got)]
+
+
+@settings(max_examples=80)
+@given(st.lists(frames, min_size=1, max_size=4), st.data())
+def test_bundle_truncation_yields_only_a_clean_prefix(frs, data):
+    wire = b"".join(bytes(encode(f)) for f in frs)
+    keep = data.draw(st.integers(0, len(wire) - 1))
+    got = list(decode_all(wire[:keep]))
+    assert len(got) < len(frs)
+    assert got == frs[: len(got)]
+
+
+# ---------------------------------------------------------------------------
 # ConnState pair under hostile frame schedules (timer-free model drive)
 # ---------------------------------------------------------------------------
 
@@ -111,6 +154,14 @@ def _payload(size: int, seed: int) -> bytes:
 def test_connstate_exactly_once_in_order_under_faults(
     msgs_a, msgs_b, window, max_backoff, drop, dup, reorder, seed
 ):
+    """Exactly-once in-order delivery under hostile schedules — now
+    including the COALESCED-ACK machine: acks only leave via
+    ``flush_acks`` (driven at arbitrary model-chosen points + the
+    on_epoch backstop), one cumulative frame may cover many DATA
+    frames, and SACK payload words cover the out-of-order tail. The
+    final conservation check pins the coalescing accounting: every
+    received DATA frame is acknowledged by exactly one flushed ack
+    datagram or rides a coalesced one."""
     rng = random.Random(seed)
     params = Params(
         epoch_limit=10**9,  # liveness is not under test; loss must not fire
@@ -121,6 +172,7 @@ def test_connstate_exactly_once_in_order_under_faults(
     )
     channel = deque()  # (dest_name, Frame) in flight
     recv = {"a": [], "b": []}
+    data_frames_rx = {"a": 0, "b": 0}  # DATA frames handed to on_frame
 
     def make(name, peer_name):
         return ConnState(
@@ -137,6 +189,11 @@ def test_connstate_exactly_once_in_order_under_faults(
     conns["a"] = make("a", "b")
     conns["b"] = make("b", "a")
 
+    def feed(dest, frame):
+        if frame.type == MsgType.DATA:
+            data_frames_rx[dest] += 1
+        conns[dest].on_frame(frame)
+
     sent_a = [_payload(s, sd) for s, sd in msgs_a]
     sent_b = [_payload(s, sd) for s, sd in msgs_b]
     # per-side write order is the delivery contract; the rng interleaves
@@ -149,15 +206,16 @@ def test_connstate_exactly_once_in_order_under_faults(
         if r < drop:
             return
         if r < drop + dup:
-            conns[dest].on_frame(frame)
-            conns[dest].on_frame(frame)
+            feed(dest, frame)
+            feed(dest, frame)
             return
         if r < drop + dup + reorder and channel:
             channel.append((dest, frame))  # overtaken by everything queued
             return
-        conns[dest].on_frame(frame)
+        feed(dest, frame)
 
-    # Phase 1 — hostile: interleave writes, faulty delivery, epochs.
+    # Phase 1 — hostile: interleave writes, faulty delivery, ack
+    # flushes at arbitrary points, epochs.
     steps = 0
     while todo["a"] or todo["b"] or channel:
         steps += 1
@@ -167,17 +225,20 @@ def test_connstate_exactly_once_in_order_under_faults(
         if sides and act < 0.3:
             side = rng.choice(sides)
             conns[side].write(todo[side].popleft())
-        elif channel and act < 0.8:
+        elif channel and act < 0.75:
             pump_one_faulty()
+        elif act < 0.85:
+            conns[rng.choice("ab")].flush_acks()
         else:
             conns[rng.choice("ab")].on_epoch()
 
     # Phase 2 — drain faithfully: every queued frame delivered, epochs
-    # tick so retransmit backoff elapses. Quiesce = nothing in flight.
+    # tick so retransmit backoff elapses and pending acks flush.
+    # Quiesce = nothing in flight.
     for _ in range(10_000):
         while channel:
             dest, frame = channel.popleft()
-            conns[dest].on_frame(frame)
+            feed(dest, frame)
         if not conns["a"].in_flight and not conns["b"].in_flight:
             if not conns["a"]._pending and not conns["b"]._pending:
                 if not channel:
@@ -190,6 +251,14 @@ def test_connstate_exactly_once_in_order_under_faults(
     assert recv["b"] == sent_a
     assert recv["a"] == sent_b
     assert not conns["a"].lost and not conns["b"].lost
+    for side in "ab":
+        conn = conns[side]
+        # coalescing conservation: after a final flush every DATA frame
+        # this side ever received (duplicates included) was covered by
+        # exactly one flushed ack emission or coalesced into one
+        conn.flush_acks()
+        assert not conn.acks_pending
+        assert conn.acks_sent + conn.acks_coalesced == data_frames_rx[side]
 
 
 # ---------------------------------------------------------------------------

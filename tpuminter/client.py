@@ -79,7 +79,15 @@ def main(argv: Optional[list] = None) -> None:
     parser.add_argument("--max-extranonce", type=int, default=None,
                         help="with --coinbase-prefix: highest extranonce to "
                         "search (default 255)")
+    parser.add_argument("--timeout", type=float, default=None,
+                        metavar="SECONDS",
+                        help="give up if no Result arrives within this many "
+                        "seconds (the reference blocks forever); prints "
+                        "'Timeout' and exits 1, like the 'Disconnected' "
+                        "path for a dead coordinator")
     args = parser.parse_args(argv)
+    if args.timeout is not None and args.timeout <= 0:
+        parser.error("--timeout must be positive seconds")
     host, _, port = args.hostport.rpartition(":")
     logging.basicConfig(level=logging.WARNING)
 
@@ -142,12 +150,22 @@ def main(argv: Optional[list] = None) -> None:
     else:
         parser.error("need either <message> <maxNonce> or --header")
 
-    async def _run() -> None:
+    async def _run() -> int:
         try:
-            result = await submit(host or "127.0.0.1", int(port), request)
+            # wait_for(None) imposes no deadline — the reference's
+            # block-forever default is preserved unless --timeout is given
+            result = await asyncio.wait_for(
+                submit(host or "127.0.0.1", int(port), request),
+                args.timeout,
+            )
+        except asyncio.TimeoutError:
+            # the wait_for cancellation propagates into submit(), whose
+            # finally-close drains the connection before we return
+            print("Timeout")
+            return 1
         except LspConnectionLost:
             print("Disconnected")
-            return
+            return 0
         if request.mode == PowMode.MIN:
             print(f"Result {result.hash_value} {result.nonce}")
         elif result.found:
@@ -162,8 +180,11 @@ def main(argv: Optional[list] = None) -> None:
                 print(f"Result {chain.hash_to_hex(digest)} {result.nonce}")
         else:
             print("Exhausted (no nonce met the target)")
+        return 0
 
-    asyncio.run(_run())
+    rc = asyncio.run(_run())
+    if rc:
+        raise SystemExit(rc)
 
 
 if __name__ == "__main__":

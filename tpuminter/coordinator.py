@@ -25,15 +25,16 @@ chosen for the heterogeneous-worker north-star, BASELINE.json:5):
 from __future__ import annotations
 
 import asyncio
+import functools
 import json
 import logging
 import random
 import struct
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from dataclasses import replace as dc_replace
-from typing import Deque, Dict, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 from tpuminter import chain
 from tpuminter.lsp import LspServer, Params
@@ -200,6 +201,11 @@ class _Job:
     #: waits for them, so a caught under-searcher's ranges are requeued
     #: BEFORE the (possibly corrupted) fold is reported to the client
     pending_audits: int = 0
+    #: chunk Results whose (executor-offloaded) verification has not
+    #: settled — an exhausted job waits for them exactly like audits, so
+    #: a burst of concurrent scrypt verifications can neither drop a
+    #: late-verifying winner nor let the job finish under it
+    pending_verifications: int = 0
     done: bool = False
     started: float = field(default_factory=time.monotonic)
     hashes_done: int = 0
@@ -210,7 +216,26 @@ class _Job:
 
     @property
     def exhausted(self) -> bool:
-        return not self.ranges and not self.inflight and self.pending_audits == 0
+        return (
+            not self.ranges
+            and not self.inflight
+            and self.pending_audits == 0
+            and self.pending_verifications == 0
+        )
+
+
+@functools.lru_cache(maxsize=4096)
+def _rolled_prefix76(
+    header: bytes, cb_prefix: bytes, cb_suffix: bytes, en_size: int,
+    branch: Tuple[bytes, ...], en: int,
+) -> bytes:
+    """First 76 bytes of the header actually mined at ``en`` — the
+    coinbase-txid → merkle-fold → header-pack chain that rolled
+    verification used to re-derive PER RESULT. A fleet hammering one
+    rolled job revisits the same few extranonces constantly; the LRU
+    turns each revisit into a dict hit."""
+    cb = chain.CoinbaseTemplate(cb_prefix, cb_suffix, en_size)
+    return chain.rolled_header(header, cb, branch, en).pack()[:76]
 
 
 class Coordinator:
@@ -260,21 +285,35 @@ class Coordinator:
             )
         self._hedge_after = hedge_after
         self._miners: Dict[int, _MinerState] = {}
+        #: live idle set (conn_id → miner, FIFO order): maintained
+        #: incrementally on join/lost/result/refuse/cancel so _dispatch
+        #: never scans the whole fleet (the old per-message rebuild was
+        #: O(miners) × message rate — the fleet-64 profile's top
+        #: coordinator entry)
+        self._idle: "OrderedDict[int, _MinerState]" = OrderedDict()
+        self._dispatch_scheduled = False
         self._clients: Dict[int, set] = {}        # client conn → its job_ids
         self._jobs: Dict[int, _Job] = {}
         self._rotation: Deque[int] = deque()      # job_ids with queued ranges
         self._next_job_id = 1
         self._next_chunk_id = 1
+        #: recent assign→result round-trip times in seconds (dispatch
+        #: write to accepted Result), for the control-plane harness
+        #: (scripts/loadgen.py); bounded so a long-running coordinator
+        #: never grows it
+        self.latencies: Deque[float] = deque(maxlen=65536)
         #: cumulative (hashes searched, jobs finished) — observability (§5)
         self.stats = {
             "hashes": 0,
             "jobs_done": 0,
+            "results_accepted": 0,
             "chunks_requeued": 0,
             "results_rejected": 0,
             "chunks_hedged": 0,
             "audits_done": 0,
             "audits_failed": 0,
             "audits_inconclusive": 0,
+            "verifications_offloaded": 0,
         }
 
     @classmethod
@@ -308,7 +347,13 @@ class Coordinator:
     # -- event loop ------------------------------------------------------
 
     async def serve(self) -> None:
-        """Process events forever (≙ reference server main loop, §3.3)."""
+        """Process events forever (≙ reference server main loop, §3.3).
+
+        Events are drained in BURSTS: one await pulls the first queued
+        event, then ``read_nowait`` empties whatever else the transport
+        already delivered, and the (dirty-flag-coalesced) dispatch runs
+        once per burst — not once per message — so a fleet-64 result
+        storm costs one dispatch pass and one task wakeup, not 64."""
         ticker = None
         if self._hedge_after is not None:
             # the scheduler is otherwise purely event-driven; hedging
@@ -318,33 +363,65 @@ class Coordinator:
         rate_ticker = asyncio.ensure_future(self._rate_ticker())
         try:
             while True:
-                conn_id, payload = await self._server.read()
-                if payload is None:
-                    self._on_lost(conn_id)
-                    continue
-                try:
-                    msg = decode_msg(payload)
-                except ProtocolError as exc:
-                    log.warning(
-                        "conn %d: malformed message dropped: %s", conn_id, exc
-                    )
-                    continue
-                if isinstance(msg, Join):
-                    self._on_join(conn_id, msg)
-                elif isinstance(msg, Request):
-                    self._on_request(conn_id, msg)
-                elif isinstance(msg, Result):
-                    self._on_result(conn_id, msg)
-                elif isinstance(msg, Refuse):
-                    self._on_refuse(conn_id, msg)
-                else:
-                    log.warning(
-                        "conn %d: unexpected %s", conn_id, type(msg).__name__
-                    )
+                event = await self._server.read()
+                while event is not None:
+                    self._handle_event(event)
+                    event = self._server.read_nowait()
+                self._run_scheduled_dispatch()
         finally:
             rate_ticker.cancel()
             if ticker is not None:
                 ticker.cancel()
+
+    def _handle_event(self, event: Tuple[int, Optional[bytes]]) -> None:
+        conn_id, payload = event
+        if payload is None:
+            self._on_lost(conn_id)
+            return
+        try:
+            msg = decode_msg(payload)
+        except ProtocolError as exc:
+            log.warning(
+                "conn %d: malformed message dropped: %s", conn_id, exc
+            )
+            return
+        # dispatch order mirrors steady-state frequency: Results dominate
+        if isinstance(msg, Result):
+            self._on_result(conn_id, msg)
+        elif isinstance(msg, Refuse):
+            self._on_refuse(conn_id, msg)
+        elif isinstance(msg, Join):
+            self._on_join(conn_id, msg)
+        elif isinstance(msg, Request):
+            self._on_request(conn_id, msg)
+        else:
+            log.warning(
+                "conn %d: unexpected %s", conn_id, type(msg).__name__
+            )
+
+    # -- dispatch scheduling ---------------------------------------------
+
+    def _schedule_dispatch(self) -> None:
+        """Mark the dispatch state dirty; the actual pass runs ONCE per
+        event-loop tick however many events requested it (serve()'s
+        burst drain runs it at batch end; the call_soon is the backstop
+        for paths outside serve, e.g. offloaded-verification settles)."""
+        if self._dispatch_scheduled:
+            return
+        self._dispatch_scheduled = True
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            # no loop (unit-level drives): run synchronously
+            self._run_scheduled_dispatch()
+            return
+        loop.call_soon(self._run_scheduled_dispatch)
+
+    def _run_scheduled_dispatch(self) -> None:
+        if not self._dispatch_scheduled:
+            return
+        self._dispatch_scheduled = False
+        self._dispatch()
 
     async def _rate_ticker(self) -> None:
         """Periodic aggregate rate line — the heartbeat a long-running
@@ -432,25 +509,43 @@ class Coordinator:
 
     # -- membership ------------------------------------------------------
 
+    def _mark_idle(self, miner: _MinerState) -> None:
+        """Record a miner as dispatchable in the live idle set (only
+        miners still in the fleet with no assignment qualify)."""
+        if miner.chunk is None and miner.conn_id in self._miners:
+            self._idle[miner.conn_id] = miner
+
+    def _drop_miner(self, conn_id: int) -> None:
+        """Remove a miner from the fleet AND the idle set (the one
+        place eviction/death bookkeeping lives, so the two structures
+        cannot diverge)."""
+        self._miners.pop(conn_id, None)
+        self._idle.pop(conn_id, None)
+
     def _on_join(self, conn_id: int, msg: Join) -> None:
         if conn_id in self._miners:
             return  # duplicate Join: already registered
-        self._miners[conn_id] = _MinerState(
+        miner = _MinerState(
             conn_id, msg.backend, max(1, msg.lanes), span=max(0, msg.span)
         )
+        self._miners[conn_id] = miner
+        self._idle[conn_id] = miner
         log.info(
             "miner %d joined (backend=%s, lanes=%d, span=%d)",
             conn_id, msg.backend, msg.lanes, msg.span,
         )
-        self._dispatch()
+        self._schedule_dispatch()
 
     def _release_assignment(self, conn_id: int, miner: _MinerState) -> None:
         """Requeue whatever a departing miner held — a job chunk back to
-        its job, an in-flight audit back to the audit queue."""
+        its job, an in-flight audit back to the audit queue. Marks the
+        miner idle again when it is staying in the fleet (the caller
+        drops it afterwards if not)."""
         if miner.chunk is None:
             return
         chunk_id, job_id, lo, hi = miner.chunk
         miner.chunk = None
+        self._mark_idle(miner)
         audit = self._audits.pop(chunk_id, None)
         if audit is not None:
             self._audit_queue.append(audit)  # retry on another worker
@@ -465,14 +560,15 @@ class Coordinator:
             )
 
     def _on_lost(self, conn_id: int) -> None:
-        miner = self._miners.pop(conn_id, None)
+        miner = self._miners.get(conn_id)
         if miner is not None:
+            self._drop_miner(conn_id)
             if miner.chunk is not None:
                 self._release_assignment(conn_id, miner)
                 log.info("miner %d died", conn_id)
             else:
                 log.info("idle miner %d died", conn_id)
-            self._dispatch()
+            self._schedule_dispatch()
             return
         job_ids = self._clients.pop(conn_id, None)
         if job_ids:
@@ -482,7 +578,7 @@ class Coordinator:
             # abandoning marked the dead client's cancelled miners idle;
             # other clients' queued jobs must not wait for an unrelated
             # event to claim them (ADVICE.md r1)
-            self._dispatch()
+            self._schedule_dispatch()
 
     # -- job lifecycle ---------------------------------------------------
 
@@ -506,7 +602,7 @@ class Coordinator:
             "client %d submitted job %d: mode=%s range=[%d, %d]",
             conn_id, job_id, msg.mode.value, msg.lower, msg.upper,
         )
-        self._dispatch()
+        self._schedule_dispatch()
 
     def _on_result(self, conn_id: int, msg: Result) -> None:
         miner = self._miners.get(conn_id)
@@ -518,61 +614,167 @@ class Coordinator:
             # leave it untouched, but give idle miners a chance at queued
             # work before returning (ADVICE.md r1: returning early here
             # could strand queued jobs until an unrelated event).
-            self._dispatch()
+            self._schedule_dispatch()
             return
         _, job_id, lo, hi = miner.chunk
+        dispatched_at = miner.chunk_at
         miner.chunk = None
+        self._mark_idle(miner)
         audit = self._audits.pop(msg.chunk_id, None)
         if audit is not None:
             self._settle_audit(conn_id, miner, audit, msg)
-            self._dispatch()
+            self._schedule_dispatch()
             return
         job = self._jobs.get(job_id)
         if job is not None and not job.done:
             job.inflight.pop(conn_id, None)
-            if not self._verify_result(job.request, msg):
-                # one buggy/malicious backend must not corrupt the fold or
-                # report a wrong winner to the client (ADVICE.md r1): drop
-                # the claim, requeue the chunk for an honest worker.
-                log.warning(
-                    "miner %d returned an unverifiable result for job %d "
-                    "(nonce=%d); chunk [%d, %d] requeued",
-                    conn_id, job_id, msg.nonce, lo, hi,
-                )
-                self.stats["results_rejected"] += 1
-                self._requeue_chunk(job, lo, hi)
-                miner.rejections += 1
-                if miner.rejections >= MAX_REJECTIONS:
-                    # a backend that keeps producing garbage would ping-
-                    # pong its own rejected chunk forever: evict it.
-                    log.warning(
-                        "miner %d evicted after %d unverifiable results",
-                        conn_id, miner.rejections,
-                    )
-                    self._miners.pop(conn_id, None)
-                    self._server.close_conn(conn_id)
-                self._dispatch()
+            if job.request.mode == PowMode.SCRYPT:
+                # memory-hard verification (~hashlib.scrypt, ≥300 µs a
+                # call) must not run on the event loop: a fleet-wide
+                # result burst verifying inline would stall epoch
+                # heartbeats. Offload to the executor; the job stays
+                # open (pending_verifications) until the claim settles,
+                # and the miner is already idle for its next chunk.
+                # Hedges settle NOW, not at accept: with both copies'
+                # verifications in flight at once, the loser's Result
+                # must already fail the chunk-id gate (the inline path
+                # got this ordering for free). If this claim then fails
+                # verification, the reject path requeues the range, so
+                # cancelling the loser early never loses coverage.
+                if self._hedge_after is not None:
+                    self._settle_hedges(job, conn_id, lo, hi)
+                job.pending_verifications += 1
+                self.stats["verifications_offloaded"] += 1
+                asyncio.ensure_future(self._settle_offloaded(
+                    conn_id, job_id, lo, hi, dispatched_at, msg
+                ))
+                self._schedule_dispatch()
                 return
-            searched = msg.searched if msg.searched > 0 else hi - lo + 1
-            job.hashes_done += searched
-            self.stats["hashes"] += searched
-            miner.hashes += searched
-            miner.chunks_done += 1
-            miner.refusals = 0  # accepted work: the peer is functional
-            miner.last_result = time.monotonic()
-            if self._hedge_after is not None:
-                self._settle_hedges(job, conn_id, lo, hi)
-            job.fold(msg.hash_value, msg.nonce)
-            if msg.found and job.request.mode.targeted:
-                self._finish_job(job, found=True)
+            if self._verify_result(job.request, msg):
+                self._accept_result(
+                    conn_id, miner, job, msg, lo, hi, dispatched_at
+                )
             else:
-                if (
-                    self._audit_rate > 0
-                    and self._audit_rng.random() < self._audit_rate
-                ):
-                    self._enqueue_audit(job, conn_id, msg, lo, hi)
-                self._maybe_finish_exhausted(job)
-        self._dispatch()
+                self._reject_result(conn_id, job, msg, lo, hi)
+        self._schedule_dispatch()
+
+    async def _settle_offloaded(
+        self, conn_id: int, job_id: int, lo: int, hi: int,
+        dispatched_at: float, msg: Result,
+    ) -> None:
+        """Settle one executor-verified Result. The fleet may have
+        churned while the hash ran: every actor is re-looked-up, and a
+        job that finished/retired meanwhile just absorbs the decrement
+        (its answer is already correct — `exhausted` waited for us)."""
+        job = self._jobs.get(job_id)
+        req = job.request if job is not None else None
+        if req is None:
+            return
+        try:
+            ok = await asyncio.get_running_loop().run_in_executor(
+                None, self._verify_result, req, msg
+            )
+        except Exception:
+            # verifier machinery failed (executor shut down mid-close,
+            # hashlib under memory pressure, ...): the counter MUST
+            # still settle or the job can never exhaust, and the claim
+            # is inconclusive — requeue the range with no strike
+            # against the (possibly honest) prover
+            log.exception(
+                "offloaded verification crashed for job %d chunk [%d, %d]",
+                job_id, lo, hi,
+            )
+            job = self._jobs.get(job_id)
+            if job is not None:
+                job.pending_verifications -= 1
+                if not job.done:
+                    self._requeue_chunk(job, lo, hi)
+                    self._schedule_dispatch()
+            return
+        job = self._jobs.get(job_id)
+        if job is None:
+            return
+        job.pending_verifications -= 1
+        if job.done:
+            return
+        miner = self._miners.get(conn_id)
+        if ok:
+            if miner is not None:
+                self._accept_result(
+                    conn_id, miner, job, msg, lo, hi, dispatched_at
+                )
+            else:
+                # the prover died while we verified — its work is still
+                # good (the hash is real): fold it so nothing re-mines
+                # the range, then let exhaustion settle
+                searched = msg.searched if msg.searched > 0 else hi - lo + 1
+                job.hashes_done += searched
+                self.stats["hashes"] += searched
+                job.fold(msg.hash_value, msg.nonce)
+                if msg.found and job.request.mode.targeted:
+                    self._finish_job(job, found=True)
+                else:
+                    self._maybe_finish_exhausted(job)
+        else:
+            self._reject_result(conn_id, job, msg, lo, hi)
+            self._maybe_finish_exhausted(job)
+        self._schedule_dispatch()
+
+    def _accept_result(
+        self, conn_id: int, miner: _MinerState, job: _Job, msg: Result,
+        lo: int, hi: int, dispatched_at: float,
+    ) -> None:
+        """Book a verified chunk Result: accounting, hedge settlement,
+        fold, and job completion (shared by the inline and offloaded
+        verification paths)."""
+        searched = msg.searched if msg.searched > 0 else hi - lo + 1
+        job.hashes_done += searched
+        self.stats["hashes"] += searched
+        self.stats["results_accepted"] += 1
+        self.latencies.append(time.monotonic() - dispatched_at)
+        miner.hashes += searched
+        miner.chunks_done += 1
+        miner.refusals = 0  # accepted work: the peer is functional
+        miner.last_result = time.monotonic()
+        if self._hedge_after is not None:
+            self._settle_hedges(job, conn_id, lo, hi)
+        job.fold(msg.hash_value, msg.nonce)
+        if msg.found and job.request.mode.targeted:
+            self._finish_job(job, found=True)
+        else:
+            if (
+                self._audit_rate > 0
+                and self._audit_rng.random() < self._audit_rate
+            ):
+                self._enqueue_audit(job, conn_id, msg, lo, hi)
+            self._maybe_finish_exhausted(job)
+
+    def _reject_result(
+        self, conn_id: int, job: _Job, msg: Result, lo: int, hi: int
+    ) -> None:
+        """One buggy/malicious backend must not corrupt the fold or
+        report a wrong winner to the client (ADVICE.md r1): drop the
+        claim, requeue the chunk for an honest worker, and evict repeat
+        offenders (bounding the requeue ping-pong)."""
+        log.warning(
+            "miner %d returned an unverifiable result for job %d "
+            "(nonce=%d); chunk [%d, %d] requeued",
+            conn_id, job.job_id, msg.nonce, lo, hi,
+        )
+        self.stats["results_rejected"] += 1
+        self._requeue_chunk(job, lo, hi)
+        miner = self._miners.get(conn_id)
+        if miner is None:
+            return  # already gone (died mid-verification)
+        miner.rejections += 1
+        if miner.rejections >= MAX_REJECTIONS:
+            log.warning(
+                "miner %d evicted after %d unverifiable results",
+                conn_id, miner.rejections,
+            )
+            self._release_assignment(conn_id, miner)
+            self._drop_miner(conn_id)
+            self._server.close_conn(conn_id)
 
     def _maybe_finish_exhausted(self, job: _Job) -> None:
         """Finish a job whose search space is fully covered — no queued
@@ -613,9 +815,9 @@ class Coordinator:
                 "miner %d evicted after %d consecutive refusals",
                 conn_id, miner.refusals,
             )
-            self._miners.pop(conn_id, None)
+            self._drop_miner(conn_id)
             self._server.close_conn(conn_id)
-        self._dispatch()
+        self._schedule_dispatch()
 
     # -- under-search audits (VERDICT r3 missing #4) ---------------------
 
@@ -667,6 +869,7 @@ class Coordinator:
         self._next_chunk_id += 1
         miner.chunk = (chunk_id, job.job_id, audit.req.lower, audit.req.upper)
         miner.chunk_at = time.monotonic()
+        self._idle.pop(miner.conn_id, None)
         self._audits[chunk_id] = audit
         try:
             self._write_dispatch(
@@ -703,7 +906,7 @@ class Coordinator:
                     auditor_conn, auditor.rejections,
                 )
                 self._release_assignment(auditor_conn, auditor)
-                self._miners.pop(auditor_conn, None)
+                self._drop_miner(auditor_conn)
                 self._server.close_conn(auditor_conn)
             self._audit_queue.append(audit)
             if job is not None:
@@ -763,7 +966,7 @@ class Coordinator:
             suspect = self._miners.get(audit.suspect)
             if suspect is not None:
                 self._release_assignment(audit.suspect, suspect)
-                self._miners.pop(audit.suspect, None)
+                self._drop_miner(audit.suspect)
                 self._server.close_conn(audit.suspect)
             if job is not None and not job.done:
                 self._requeue_chunk(job, lo, hi)
@@ -827,19 +1030,28 @@ class Coordinator:
                 return chain.toy_hash(req.data, msg.nonce) == msg.hash_value
             if req.rolled:
                 en, nonce = chain.split_global(msg.nonce, req.nonce_bits)
-                cb = chain.CoinbaseTemplate(
-                    req.coinbase_prefix, req.coinbase_suffix,
-                    req.extranonce_size,
+                # the coinbase-roll re-derivation is LRU-cached per
+                # (template, extranonce) — a fleet revisits few en values
+                prefix = _rolled_prefix76(
+                    req.header, req.coinbase_prefix, req.coinbase_suffix,
+                    req.extranonce_size, req.branch, en,
                 )
-                prefix = chain.rolled_header(
-                    req.header, cb, req.branch, en
-                ).pack()[:76]
             else:
                 nonce = msg.nonce
                 prefix = req.header[:76]
-            powf = chain.scrypt_hash if req.mode == PowMode.SCRYPT else chain.dsha256
+            # double-SHA stays on hashlib: the native batch-verify
+            # entry point (native_verify.dsha256_header_batch) measured
+            # SLOWER at every shape on this host — 7.6 µs single /
+            # 2.0 µs batched-64 vs hashlib's 1.2 µs (OpenSSL's
+            # vectorized SHA + no FFI) — so it is available but
+            # rejected here by the numbers (PERF.md, control-plane
+            # section).
+            powf = (
+                chain.scrypt_hash if req.mode == PowMode.SCRYPT
+                else chain.dsha256
+            )
             h = chain.hash_to_int(powf(prefix + struct.pack("<I", nonce)))
-        except (struct.error, TypeError, OverflowError):
+        except (struct.error, TypeError, OverflowError, ValueError):
             return False
         if h != msg.hash_value:
             return False
@@ -908,10 +1120,12 @@ class Coordinator:
             if miner is not None and miner.chunk is not None \
                     and miner.chunk[1] == job.job_id:
                 miner.chunk = None
+                self._mark_idle(miner)
             try:
                 self._server.write(miner_conn, encode_msg(Cancel(job.job_id)))
             except ConnectionError:
                 pass
+        self._schedule_dispatch()  # freed miners must not wait for an event
         try:
             self._rotation.remove(job.job_id)
         except ValueError:
@@ -926,8 +1140,21 @@ class Coordinator:
     def _dispatch(self) -> None:
         """Carve chunks off round-robin'd jobs onto idle miners (§3.3).
         Queued audits go first: their ranges are tiny and the evidence
-        goes stale as the fleet churns."""
-        idle = deque(m for m in self._miners.values() if m.chunk is None)
+        goes stale as the fleet churns.
+
+        Works off the LIVE idle set (``_idle``, maintained on every
+        join/lost/result/refuse/cancel transition) instead of scanning
+        the whole fleet, and runs once per event-loop tick however many
+        events dirtied it (``_schedule_dispatch``): a fleet-64 result
+        burst costs one O(idle) pass, not 64 O(miners) rebuilds. A
+        miner whose dispatch write fails is quarantined for this pass
+        (its conn is dead; the loss event is already queued) and
+        returned to the idle set afterwards for _on_lost to reap."""
+        if not self._idle:
+            return
+        idle: Deque[_MinerState] = deque(self._idle.values())
+        self._idle.clear()
+        failed: List[_MinerState] = []
         held: Deque[_Audit] = deque()
         while self._audit_queue and idle:
             audit = self._audit_queue.popleft()
@@ -945,6 +1172,7 @@ class Coordinator:
             idle.remove(auditor)
             if not self._assign_audit(auditor, job, audit):
                 held.append(audit)
+                failed.append(auditor)
         self._audit_queue.extendleft(reversed(held))
         while idle and self._rotation:
             job_id = self._rotation[0]
@@ -960,11 +1188,16 @@ class Coordinator:
                 job.ranges.appendleft((chunk_hi + 1, hi))
             if not self._assign(miner, job, lo, chunk_hi):
                 job.ranges.appendleft((lo, chunk_hi))
+                failed.append(miner)
                 continue
             # rotate: next dispatch serves the next job
             self._rotation.rotate(-1)
         if self._hedge_after is not None and idle:
             self._hedge(idle)
+        for m in idle:
+            self._mark_idle(m)
+        for m in failed:
+            self._mark_idle(m)
 
     def _budget(self, miner: _MinerState, job: _Job) -> int:
         """Per-dispatch nonce budget for this (miner, dialect) pair."""
@@ -1002,6 +1235,7 @@ class Coordinator:
         self._next_chunk_id += 1
         miner.chunk = (chunk_id, job.job_id, lo, hi)
         miner.chunk_at = time.monotonic()
+        self._idle.pop(miner.conn_id, None)
         job.inflight[miner.conn_id] = (lo, hi)
         try:
             self._write_dispatch(miner, job, chunk_id, lo, hi)
@@ -1078,6 +1312,7 @@ class Coordinator:
                 and m.chunk[1:] == (job.job_id, lo, hi)
             ):
                 m.chunk = None
+                self._mark_idle(m)
                 job.inflight.pop(m.conn_id, None)
                 # the job is still live and this Cancel makes the loser
                 # evict its template — forget we Setup it so a later

@@ -10,8 +10,8 @@ import asyncio
 from typing import Optional, Tuple, Union
 
 import tpuminter.lsp as lsp
-from tpuminter.lsp.connection import ConnState
-from tpuminter.lsp.message import Frame, MsgType, decode, encode
+from tpuminter.lsp.connection import ACK_DELAY_S, ConnState
+from tpuminter.lsp.message import Frame, MsgType, decode_all, encode
 from tpuminter.lsp.params import Params
 from tpuminter.lsp.transport import UdpEndpoint
 
@@ -35,6 +35,7 @@ class LspClient:
         self._connect_waiter: Optional[asyncio.Future] = None
         self._epoch_task: Optional[asyncio.Task] = None
         self._lost_reason: Optional[str] = None
+        self._ack_flush_scheduled = False
 
     # -- construction ----------------------------------------------------
 
@@ -92,6 +93,8 @@ class LspClient:
             send_frame=self._send_frame,
             deliver=self._recv.put_nowait,
             on_lost=self._handle_lost,
+            send_wires=self._send_wires,
+            request_flush=self._schedule_flush,
         )
         self._epoch_task = asyncio.ensure_future(self._epoch_loop())
         return self
@@ -102,22 +105,51 @@ class LspClient:
         assert self._endpoint is not None
         self._endpoint.send(encode(frame), self._server_addr)
 
+    def _send_wires(self, wires) -> None:
+        assert self._endpoint is not None
+        self._endpoint.send_batch(wires, self._server_addr)
+
     def _on_datagram(self, data: bytes, addr: Tuple[str, int]) -> None:
-        frame = decode(data)
-        if frame is None:
-            return
-        if self._conn is None:
-            # handshake phase: the connect-ack is ACK seq 0 carrying our id
-            if (
-                frame.type == MsgType.ACK
-                and frame.seq == 0
-                and self._connect_waiter is not None
-                and not self._connect_waiter.done()
-            ):
-                self._connect_waiter.set_result(frame.conn_id)
-            return
-        if frame.conn_id == self._conn.conn_id:
-            self._conn.on_frame(frame)
+        for frame in decode_all(data):
+            if self._conn is None:
+                # handshake phase: the connect-ack is ACK seq 0 with our id
+                if (
+                    frame.type == MsgType.ACK
+                    and frame.seq == 0
+                    and self._connect_waiter is not None
+                    and not self._connect_waiter.done()
+                ):
+                    self._connect_waiter.set_result(frame.conn_id)
+                continue
+            if frame.conn_id == self._conn.conn_id:
+                self._conn.on_frame(frame)
+        conn = self._conn
+        if conn is not None and conn.acks_pending:
+            if conn.ack_urgent:
+                # window-blocked fragmented transfer: ack immediately
+                conn.flush_tx()
+            elif not conn.ack_timer_armed:
+                # delayed standalone ack (see connection.ACK_DELAY_S):
+                # app responses within the delay carry the ack for free
+                conn.ack_timer_armed = True
+                asyncio.get_running_loop().call_later(
+                    ACK_DELAY_S, self._ack_timer_fire
+                )
+
+    def _ack_timer_fire(self) -> None:
+        if self._conn is not None:
+            self._conn.ack_timer_armed = False
+            self._conn.flush_tx()
+
+    def _schedule_flush(self, conn) -> None:
+        if not self._ack_flush_scheduled:
+            self._ack_flush_scheduled = True
+            asyncio.get_running_loop().call_soon(self._flush_tx_cb)
+
+    def _flush_tx_cb(self) -> None:
+        self._ack_flush_scheduled = False
+        if self._conn is not None:
+            self._conn.flush_tx()
 
     def _handle_lost(self, reason: str) -> None:
         self._lost_reason = reason
@@ -153,6 +185,21 @@ class LspClient:
         item = await self._recv.get()
         if item is _LOST:
             self._recv.put_nowait(_LOST)  # subsequent reads keep failing
+            raise lsp.LspConnectionLost(
+                self.conn_id, self._lost_reason or "connection lost"
+            )
+        return item  # type: ignore[return-value]
+
+    def read_nowait(self) -> Optional[bytes]:
+        """The already-buffered next payload, or None when the queue is
+        empty — drains a delivered burst without one task wakeup per
+        message. Raises like :meth:`read` once the connection is lost."""
+        try:
+            item = self._recv.get_nowait()
+        except asyncio.QueueEmpty:
+            return None
+        if item is _LOST:
+            self._recv.put_nowait(_LOST)
             raise lsp.LspConnectionLost(
                 self.conn_id, self._lost_reason or "connection lost"
             )

@@ -12,8 +12,8 @@ from __future__ import annotations
 import asyncio
 from typing import Dict, Optional, Tuple
 
-from tpuminter.lsp.connection import ConnState
-from tpuminter.lsp.message import Frame, MsgType, decode, encode
+from tpuminter.lsp.connection import ACK_DELAY_S, ConnState
+from tpuminter.lsp.message import Frame, MsgType, decode_all, encode
 from tpuminter.lsp.params import Params
 from tpuminter.lsp.transport import Addr, UdpEndpoint
 
@@ -30,6 +30,14 @@ class LspServer:
         self._next_conn_id = 1
         self._events: "asyncio.Queue[Tuple[int, Optional[bytes]]]" = asyncio.Queue()
         self._epoch_task: Optional[asyncio.Task] = None
+        # coalesced-ack bookkeeping: conns with pending acks, flushed
+        # once per event-loop tick (ConnState.flush_acks)
+        self._ack_dirty: set = set()
+        self._ack_flush_scheduled = False
+        # running totals from conns already forgotten, so ack_stats()
+        # survives connection churn
+        self._acks_sent_closed = 0
+        self._acks_coalesced_closed = 0
 
     @classmethod
     async def create(
@@ -51,19 +59,36 @@ class LspServer:
     # -- wiring ----------------------------------------------------------
 
     def _on_datagram(self, data: bytes, addr: Addr) -> None:
-        frame = decode(data)
-        if frame is None:
-            return
         conn = self._by_addr.get(addr)
-        if frame.type == MsgType.CONNECT:
-            if conn is None:
-                conn = self._new_conn(addr)
-            # (re-)ack the handshake; duplicate CONNECTs mean our ack was lost
-            self._send_to(addr, Frame(MsgType.ACK, conn.conn_id, 0))
-            conn.on_frame(frame)
-        elif conn is not None and frame.conn_id == conn.conn_id:
-            conn.on_frame(frame)
-        # frames for unknown/stale connections are dropped
+        for frame in decode_all(data):
+            if frame.type == MsgType.CONNECT:
+                if conn is None:
+                    conn = self._new_conn(addr)
+                # (re-)ack the handshake; duplicate CONNECTs mean our
+                # ack was lost
+                self._send_to(addr, Frame(MsgType.ACK, conn.conn_id, 0))
+                conn.on_frame(frame)
+            elif conn is not None and frame.conn_id == conn.conn_id:
+                conn.on_frame(frame)
+            # frames for unknown/stale connections are dropped
+        if conn is not None and conn.acks_pending:
+            if conn.ack_urgent:
+                # a window-blocked sender mid-fragmented-message cannot
+                # wait the piggyback delay
+                conn.flush_tx()
+            elif not conn.ack_timer_armed:
+                # delayed standalone ack: give the app ACK_DELAY_S to
+                # answer (the ack then piggybacks on the response
+                # datagram for free); peers with nothing to say ack on
+                # the timer
+                conn.ack_timer_armed = True
+                asyncio.get_running_loop().call_later(
+                    ACK_DELAY_S, self._ack_timer_fire, conn
+                )
+
+    def _ack_timer_fire(self, conn: ConnState) -> None:
+        conn.ack_timer_armed = False
+        conn.flush_tx()
 
     def _new_conn(self, addr: Addr) -> ConnState:
         conn_id = self._next_conn_id
@@ -76,15 +101,35 @@ class LspServer:
                 (cid, payload)
             ),
             on_lost=lambda reason, cid=conn_id: self._handle_lost(cid),
+            send_wires=lambda wires, a=addr: self._send_wires_to(a, wires),
+            request_flush=self._schedule_flush,
         )
         self._by_addr[addr] = conn
         self._by_id[conn_id] = conn
         self._addr_of[conn_id] = addr
         return conn
 
+    def _schedule_flush(self, conn: ConnState) -> None:
+        """One bundled flush per event-loop tick per dirty conn,
+        however many frames its sends queued in that tick."""
+        self._ack_dirty.add(conn)
+        if not self._ack_flush_scheduled:
+            self._ack_flush_scheduled = True
+            asyncio.get_running_loop().call_soon(self._flush_dirty)
+
+    def _flush_dirty(self) -> None:
+        self._ack_flush_scheduled = False
+        dirty, self._ack_dirty = self._ack_dirty, set()
+        for conn in dirty:
+            conn.flush_tx()
+
     def _send_to(self, addr: Addr, frame: Frame) -> None:
         assert self._endpoint is not None
         self._endpoint.send(encode(frame), addr)
+
+    def _send_wires_to(self, addr: Addr, wires) -> None:
+        assert self._endpoint is not None
+        self._endpoint.send_batch(wires, addr)
 
     def _handle_lost(self, conn_id: int) -> None:
         self._events.put_nowait((conn_id, None))
@@ -94,7 +139,11 @@ class LspServer:
         addr = self._addr_of.pop(conn_id, None)
         if addr is not None:
             self._by_addr.pop(addr, None)
-        self._by_id.pop(conn_id, None)
+        conn = self._by_id.pop(conn_id, None)
+        if conn is not None:
+            self._ack_dirty.discard(conn)
+            self._acks_sent_closed += conn.acks_sent
+            self._acks_coalesced_closed += conn.acks_coalesced
 
     async def _epoch_loop(self) -> None:
         while True:
@@ -117,6 +166,26 @@ class LspServer:
         """Next event from any client: ``(conn_id, payload)``, where a
         ``None`` payload means the connection was declared lost."""
         return await self._events.get()
+
+    def read_nowait(self) -> Optional[Tuple[int, Optional[bytes]]]:
+        """The already-queued next event, or None if the queue is empty
+        — lets an event-driven owner drain a whole burst without one
+        task wakeup per message (coordinator.serve)."""
+        try:
+            return self._events.get_nowait()
+        except asyncio.QueueEmpty:
+            return None
+
+    def ack_stats(self) -> dict:
+        """Coalesced-ack counters across all connections, live and
+        closed: ``acks_sent`` datagrams carried ``acks_sent +
+        acks_coalesced`` DATA acknowledgements."""
+        return {
+            "acks_sent": self._acks_sent_closed
+            + sum(c.acks_sent for c in self._by_id.values()),
+            "acks_coalesced": self._acks_coalesced_closed
+            + sum(c.acks_coalesced for c in self._by_id.values()),
+        }
 
     def write(self, conn_id: int, payload: bytes) -> None:
         conn = self._by_id.get(conn_id)

@@ -135,6 +135,28 @@ class UdpEndpoint(asyncio.DatagramProtocol):
             else:
                 self._send_now(data, addr)
 
+    def send_batch(self, datagrams, addr: Addr) -> None:
+        """Gathered write: several datagrams to one peer in one call —
+        the retransmit-storm / coalesced-flush fast path. With no write
+        faults configured, the per-datagram dispatch overhead (closing
+        checks, fault draws) is paid once for the burst; with faults,
+        each datagram individually goes through :meth:`send` so drop/
+        dup/reorder statistics are indistinguishable from looped sends."""
+        if (
+            self.write_drop_rate > 0
+            or self.write_dup_rate > 0
+            or self.write_reorder_rate > 0
+        ):
+            for data in datagrams:
+                self.send(data, addr)
+            return
+        if self._transport is None or self._transport.is_closing():
+            return
+        sendto = self._transport.sendto
+        for data in datagrams:
+            self.sent += 1
+            sendto(data, addr)
+
     def _send_now(self, data: bytes, addr: Addr) -> None:
         if self._transport is None or self._transport.is_closing():
             return  # a held-back (reordered) datagram outlived the socket

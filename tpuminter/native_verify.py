@@ -1,0 +1,111 @@
+"""Coordinator-side hash verification through the native core.
+
+``coordinator._verify_result`` re-derives one double-SHA per accepted
+TARGET/rolled chunk Result (and audits do the same); at fleet scale that
+is the verifier-side hot loop, so it goes through the compiled
+``sha256d_hash_batch`` entry point of ``native/sha256d.cc`` when the
+shared library is present and falls back to hashlib (also C, via
+OpenSSL, but paying two Python-level digest round-trips plus the
+bytes-concat per call) when it is not. The batch shape exists for
+verification bursts: one ctypes call amortizes the FFI cost over every
+(header76, nonce) pair in the burst.
+
+Import never raises — absence of the .so just means the fallback; the
+choice is made once and cached.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+from typing import List, Sequence
+
+from tpuminter import chain
+
+__all__ = ["available", "dsha256_header", "dsha256_header_batch"]
+
+_lib = None
+_probed = False
+
+
+def _load():
+    """The native library with the batch entry typed, or None (absent
+    .so, or a stale build without the symbol)."""
+    global _lib, _probed
+    if _probed:
+        return _lib
+    _probed = True
+    try:
+        from tpuminter.native_worker import load_native_lib
+
+        lib = load_native_lib()
+        lib.sha256d_hash_batch.restype = None
+        lib.sha256d_hash_batch.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
+        _lib = lib
+    except (RuntimeError, AttributeError, OSError):
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _fallback_one(prefix76: bytes, nonce: int) -> int:
+    return chain.hash_to_int(
+        chain.dsha256(prefix76 + struct.pack("<I", nonce))
+    )
+
+
+def dsha256_header(prefix76: bytes, nonce: int) -> int:
+    """Hash value (the little-endian uint256 Bitcoin compares against
+    the target) of the 80-byte header ``prefix76 ‖ nonce_le4``."""
+    lib = _load()
+    if lib is None:
+        return _fallback_one(prefix76, nonce)
+    out = (ctypes.c_uint32 * 8)()
+    lib.sha256d_hash_batch(
+        prefix76, (ctypes.c_uint32 * 1)(nonce & 0xFFFFFFFF), 1, out
+    )
+    value = 0
+    for w in out:
+        value = (value << 32) | w
+    return value
+
+
+def dsha256_header_batch(
+    prefixes76: Sequence[bytes], nonces: Sequence[int]
+) -> List[int]:
+    """Hash values for ``count`` independent (header-prefix, nonce)
+    pairs in one native call (one FFI round-trip for a whole
+    verification burst)."""
+    if len(prefixes76) != len(nonces):
+        raise ValueError("prefixes76 and nonces must be the same length")
+    lib = _load()
+    if lib is None:
+        return [_fallback_one(p, n) for p, n in zip(prefixes76, nonces)]
+    count = len(nonces)
+    if count == 0:
+        return []
+    buf = b"".join(prefixes76)
+    if len(buf) != 76 * count:
+        raise ValueError("every header prefix must be exactly 76 bytes")
+    out = (ctypes.c_uint32 * (8 * count))()
+    lib.sha256d_hash_batch(
+        buf,
+        (ctypes.c_uint32 * count)(*(n & 0xFFFFFFFF for n in nonces)),
+        count,
+        out,
+    )
+    values = []
+    for i in range(count):
+        value = 0
+        for w in out[8 * i : 8 * i + 8]:
+            value = (value << 32) | w
+        values.append(value)
+    return values
