@@ -564,6 +564,68 @@ def bench_control_plane(fleets=(8, 64), duration: float = 5.0) -> dict:
     return out
 
 
+def bench_recovery(duration: float = 4.0, pairs: int = 3) -> dict:
+    """Durability cost + crash-recovery latency (ISSUE 3), CPU-only
+    like the control-plane section.
+
+    - ``recovery_journal_overhead_pct`` — results/s lost to write-ahead
+      journaling on the fleet-8 loadgen run. Measured PAIRED (alternate
+      base/journal runs, best-of-``pairs`` each) because this host's
+      absolute throughput swings ~2x with ambient load; the ratio of
+      bests is the stable signal.
+    - ``recovery_restart_to_first_assign_ms`` — kill -9 the journaled
+      coordinator mid-burst, restart from the journal on the same
+      port: time until a redialed miner gets its first chunk.
+    - ``recovery_dip_window_ms`` — crash until results/s recovers to
+      half its pre-crash mean (the results/s dip window).
+    - ``recovery_answers_lost`` / ``recovery_answers_duplicated`` —
+      the exactly-once ledger; both must be 0.
+    """
+    import asyncio
+    import os as _os
+    import sys as _sys
+    import tempfile
+
+    _sys.path.insert(
+        0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                         "scripts"),
+    )
+    import loadgen
+
+    base_best = journ_best = 0.0
+    for _ in range(pairs):
+        base_best = max(base_best, asyncio.run(
+            loadgen.run_load(8, 4, duration)
+        )["results_per_s"])
+        tmp = tempfile.mktemp(suffix=".wal")
+        try:
+            journ_best = max(journ_best, asyncio.run(
+                loadgen.run_load(8, 4, duration, journal_path=tmp)
+            )["results_per_s"])
+        finally:
+            if _os.path.exists(tmp):
+                _os.unlink(tmp)
+    crash = asyncio.run(loadgen.run_crash(
+        8, 2, pre=min(duration, 2.0), post=duration,
+    ))
+    return {
+        "recovery_results_per_s_base": base_best,
+        "recovery_results_per_s_journaled": journ_best,
+        "recovery_journal_overhead_pct": round(
+            100.0 * (1.0 - journ_best / base_best), 2
+        ) if base_best > 0 else None,
+        "recovery_restart_to_first_assign_ms": crash.get(
+            "restart_to_first_assign_ms"
+        ),
+        "recovery_dip_window_ms": crash.get("dip_window_ms"),
+        "recovery_replay_ms": crash.get("replay_ms"),
+        "recovery_answers_lost": crash.get("answers_lost"),
+        "recovery_answers_duplicated": crash.get("answers_duplicated"),
+        "recovery_recovered_jobs": crash.get("recovered_jobs"),
+        "recovery_recovered_winners": crash.get("recovered_winners"),
+    }
+
+
 def bench_native(seconds: float = 2.0) -> dict:
     """Measured native C++ double-SHA rate (README's backend table row;
     BASELINE.md quoted 1.84 MH/s on this host). Absent .so → empty."""
@@ -618,6 +680,7 @@ def main() -> None:
         rate = bench_jnp(1 << 14)
         extra["scrypt_khs_per_chip"] = round(bench_scrypt(64, 2) / 1e3, 3)
         extra.update(bench_control_plane(fleets=(8,), duration=1.5))
+        extra.update(bench_recovery(duration=1.5, pairs=1))
         extra.update(bench_native(seconds=0.5))
     elif jax.default_backend() == "cpu":
         # the TPU tunnel is down and jax silently fell back to CPU: say
@@ -629,6 +692,7 @@ def main() -> None:
         rate = bench_jnp(1 << 14)
         extra["scrypt_khs_per_chip"] = round(bench_scrypt(64, 2) / 1e3, 3)
         extra.update(bench_control_plane())
+        extra.update(bench_recovery())
         extra.update(bench_native())
     else:
         # persistent compilation cache, same as the worker CLI: the
@@ -652,8 +716,9 @@ def main() -> None:
         extra.update(bench_pod_exact_min())
         extra.update(bench_cold_start())
         # CPU-side sections ride along on TPU captures too: the control
-        # plane and native core are part of the system's headline
+        # plane, recovery, and native core are part of the headline
         extra.update(bench_control_plane())
+        extra.update(bench_recovery())
         extra.update(bench_native())
     ghs = rate / 1e9
     print(

@@ -29,6 +29,19 @@ CLI:  ``python scripts/loadgen.py [--miners N] [--clients M]
 burst and exits nonzero on any event-loop stall above one FAST epoch or
 any miner declared lost — the tier-1 liveness gate
 (tests/test_control_plane.py).
+
+``--scenario crash`` (ISSUE 3) instead drives the DURABLE control
+plane: the coordinator journals to a write-ahead log
+(``tpuminter.journal``), gets killed mid-burst (socket closed with no
+drain, buffered journal records lost — the in-process equivalent of
+``kill -9``), and is restarted from the journal on the same port while
+the fleet (redialing miners, re-submitting clients) resumes on its own.
+Reported: ``restart_to_first_assign_ms`` (restart to the first chunk
+dispatched to a redialed miner), ``dip_window_ms`` (crash until
+results/s recovers to half its pre-crash mean), ``answers_lost`` /
+``answers_duplicated`` (the exactly-once ledger — both must be 0), and
+the journal's record/byte/flush counters. A small-fleet variant is the
+tier-1 crash gate (tests/test_recovery.py).
 """
 
 from __future__ import annotations
@@ -36,8 +49,10 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import statistics
 import sys
+import tempfile
 import time
 from typing import Optional
 
@@ -48,8 +63,13 @@ sys.path.insert(0, __import__("os").path.dirname(
 
 from tpuminter import chain  # noqa: E402
 from tpuminter.coordinator import Coordinator  # noqa: E402
-from tpuminter.lsp import LspClient, LspConnectionLost, Params  # noqa: E402
-from tpuminter.lsp.params import FAST  # noqa: E402
+from tpuminter.lsp import (  # noqa: E402
+    LspClient,
+    LspConnectError,
+    LspConnectionLost,
+    Params,
+)
+from tpuminter.lsp.params import FAST, jittered_backoff  # noqa: E402
 from tpuminter.protocol import (  # noqa: E402
     Assign,
     Cancel,
@@ -98,10 +118,28 @@ async def _instant_miner(port: int, params: Params) -> None:
                 raw = (
                     w.read_nowait() if hasattr(w, "read_nowait") else None
                 )
-    except (LspConnectionLost, asyncio.CancelledError):
-        pass
+    except LspConnectionLost:
+        pass  # CancelledError propagates: redial wrappers must see it
     finally:
         await w.close(drain_timeout=0.2)
+
+
+async def _resilient_instant_miner(port: int, params: Params,
+                                   seed: int) -> None:
+    """An instant miner that survives coordinator restarts: when the
+    connection is lost it redials with jittered exponential backoff and
+    re-Joins (the crash scenario's fleet)."""
+    import random as _random
+
+    rng = _random.Random(seed)
+    delays = jittered_backoff(0.05, 1.0, rng)
+    while True:
+        try:
+            await _instant_miner(port, params)
+            delays = jittered_backoff(0.05, 1.0, rng)  # had a session
+        except LspConnectError:
+            pass
+        await asyncio.sleep(next(delays))
 
 
 async def _client_loop(port: int, params: Params, cid: int, upper: int,
@@ -150,10 +188,15 @@ async def run_load(
     chunks_per_job: Optional[int] = None,
     params: Params = FAST,
     warmup: float = 0.5,
+    journal_path: Optional[str] = None,
 ) -> dict:
     """Drive the fleet for ``duration`` seconds (after ``warmup``) and
-    return the metrics dict described in the module docstring."""
-    coord = await Coordinator.create(params=params, chunk_size=chunk_size)
+    return the metrics dict described in the module docstring.
+    ``journal_path`` enables write-ahead journaling — the knob behind
+    the ``recovery_journal_overhead_pct`` bench field."""
+    coord = await Coordinator.create(
+        params=params, chunk_size=chunk_size, recover_from=journal_path
+    )
     serve = asyncio.ensure_future(coord.serve())
     # jobs long enough that every miner stays busy between completions
     if chunks_per_job is None:
@@ -259,6 +302,262 @@ def smoke_check(metrics: dict, params: Params = FAST) -> list:
     return bad
 
 
+# ---------------------------------------------------------------------------
+# crash scenario (ISSUE 3): kill the coordinator mid-burst, recover
+# ---------------------------------------------------------------------------
+
+async def _durable_client_loop(
+    port: int, params: Params, cid: int, upper: int, ledger: dict
+) -> None:
+    """Closed-loop client that survives coordinator restarts: one LSP
+    connection reused across jobs; on loss it redials with jittered
+    backoff and RE-SUBMITS the in-flight request under its durable
+    client_key and original job_id (the coordinator deduplicates).
+    Every Result received is booked in ``ledger['answers']`` keyed by
+    (cid, job_id) — the exactly-once evidence the crash metrics read."""
+    import random as _random
+
+    rng = _random.Random(1000 + cid)
+    ckey = f"loadgen-{cid}"
+    answers = ledger["answers"]
+    jid = 0
+    pending: Optional[Request] = None
+    client: Optional[LspClient] = None
+    delays = jittered_backoff(0.05, 1.0, rng)
+    try:
+        while True:
+            if client is None:
+                try:
+                    client = await LspClient.connect(
+                        "127.0.0.1", port, params
+                    )
+                    delays = jittered_backoff(0.05, 1.0, rng)
+                except LspConnectError:
+                    await asyncio.sleep(next(delays))
+                    continue
+                if pending is not None:
+                    # same client_key + job_id: the restarted
+                    # coordinator re-binds or answers from its journal
+                    client.write(encode_msg(pending))
+            try:
+                if pending is None:
+                    if ledger.get("stop"):
+                        return
+                    jid += 1
+                    pending = Request(
+                        job_id=jid, mode=PowMode.MIN, lower=0, upper=upper,
+                        data=b"crash-%d-%d" % (cid, jid), client_key=ckey,
+                    )
+                    ledger["submitted"] += 1
+                    client.write(encode_msg(pending))
+                msg = decode_msg(await client.read())
+                if isinstance(msg, Result):
+                    # book EVERY Result (duplicate detection), not just
+                    # the awaited one
+                    key = (cid, msg.job_id)
+                    answers[key] = answers.get(key, 0) + 1
+                    if pending is not None and msg.job_id == pending.job_id:
+                        pending = None
+            except LspConnectionLost:
+                await client.close(drain_timeout=0.1)
+                client = None
+                await asyncio.sleep(next(delays))
+    finally:
+        ledger["unanswered"] = ledger.get("unanswered", 0) + (
+            1 if pending is not None else 0
+        )
+        if client is not None:
+            await client.close(drain_timeout=0.2)
+
+
+async def run_crash(
+    n_miners: int = 8,
+    n_clients: int = 2,
+    *,
+    journal_path: Optional[str] = None,
+    chunk_size: int = 1024,
+    chunks_per_job: Optional[int] = None,
+    params: Params = FAST,
+    pre: float = 1.5,
+    post: float = 3.0,
+    drain: float = 10.0,
+) -> dict:
+    """The crash-recovery drill: journaled coordinator + resilient
+    fleet; kill the coordinator mid-burst (socket closed, no drain,
+    buffered journal records lost — in-process ``kill -9``); restart it
+    from the journal on the SAME port; let the fleet resume on its own.
+
+    Returns the exactly-once ledger plus recovery latency metrics (see
+    the module docstring). ``pre``/``post`` bound the burst before and
+    after the kill; ``drain`` bounds the final wait for in-flight
+    requests to answer (anything still unanswered then counts lost).
+    """
+    import shutil
+
+    tmpdir = None
+    if journal_path is None:
+        tmpdir = tempfile.mkdtemp(prefix="tpuminter-loadgen-")
+        journal_path = os.path.join(tmpdir, "coordinator.wal")
+    coord = await Coordinator.create(
+        params=params, chunk_size=chunk_size, recover_from=journal_path
+    )
+    port = coord.port
+    serve = asyncio.ensure_future(coord.serve())
+    state = {"coord": coord, "carried": 0}
+    t0 = time.monotonic()
+    buckets = []  # (t_rel, results_accepted delta) per 100 ms
+
+    async def sampler() -> None:
+        last = 0
+        while True:
+            await asyncio.sleep(0.1)
+            c = state["coord"]
+            cur = state["carried"] + (
+                c.stats["results_accepted"] if c is not None else 0
+            )
+            buckets.append((time.monotonic() - t0, cur - last))
+            last = cur
+
+    if chunks_per_job is None:
+        chunks_per_job = max(8, 2 * n_miners)
+    upper = chunk_size * chunks_per_job - 1
+    ledger = {"answers": {}, "submitted": 0, "stop": False}
+    miners = [
+        asyncio.ensure_future(_resilient_instant_miner(port, params, i))
+        for i in range(n_miners)
+    ]
+    clients = [
+        asyncio.ensure_future(
+            _durable_client_loop(port, params, i, upper, ledger)
+        )
+        for i in range(n_clients)
+    ]
+    sample_task = asyncio.ensure_future(sampler())
+    metrics: dict = {
+        "fleet": n_miners, "clients": n_clients,
+        "chunk_size": chunk_size,
+    }
+    try:
+        await asyncio.sleep(pre)
+        # -- kill -9 ----------------------------------------------------
+        t_crash = time.monotonic() - t0
+        state["carried"] += coord.stats["results_accepted"]
+        state["coord"] = None
+        serve.cancel()
+        await asyncio.gather(serve, return_exceptions=True)
+        old_endpoint = coord.server.endpoint
+        coord.crash()
+        # the asyncio transport releases the port a loop tick later; a
+        # real kill -9 has the OS do this at process exit, before any
+        # restart could bind — wait it out, then bind the same port
+        await old_endpoint.wait_closed()
+        pre_results = state["carried"]
+        # -- restart from the journal on the same port -------------------
+        t_restart0 = time.monotonic()
+        for attempt in range(50):
+            try:
+                coord = await Coordinator.create(
+                    port, params=params, chunk_size=chunk_size,
+                    recover_from=journal_path,
+                )
+                break
+            except OSError:
+                if attempt == 49:
+                    raise
+                await asyncio.sleep(0.02)
+        metrics["recovered_jobs"] = len(coord._jobs)
+        metrics["recovered_winners"] = len(coord._winners)
+        metrics["replay_ms"] = round(
+            (time.monotonic() - t_restart0) * 1e3, 3
+        )
+        serve = asyncio.ensure_future(coord.serve())
+        state["coord"] = coord
+        # first assign after restart = the moment a redialed miner got
+        # work again (includes the fleet's backoff, the re-Joins, and
+        # the re-dispatch of recovered/re-submitted jobs)
+        while coord._next_chunk_id == 1:
+            if time.monotonic() - t_restart0 > max(post, 10.0):
+                break
+            await asyncio.sleep(0.001)
+        metrics["restart_to_first_assign_ms"] = round(
+            (time.monotonic() - t_restart0) * 1e3, 3
+        )
+        await asyncio.sleep(post)
+        # -- drain: no new jobs; in-flight ones get `drain` s to answer --
+        ledger["stop"] = True
+        done, pending_tasks = await asyncio.wait(clients, timeout=drain)
+        for t in pending_tasks:
+            t.cancel()
+        await asyncio.gather(*clients, return_exceptions=True)
+        # -- ledger -----------------------------------------------------
+        answers = ledger["answers"]
+        metrics["submitted"] = ledger["submitted"]
+        metrics["answered"] = sum(1 for c in answers.values() if c >= 1)
+        metrics["answers_duplicated"] = sum(
+            c - 1 for c in answers.values() if c > 1
+        )
+        # a request is lost only if it was submitted and never answered
+        # even after the drain window (clients that timed out above)
+        metrics["answers_lost"] = ledger["submitted"] - metrics["answered"]
+        metrics["results_accepted_pre_crash"] = pre_results
+        metrics["results_accepted_total"] = state["carried"] + (
+            coord.stats["results_accepted"]
+        )
+        # -- dip window: crash → results/s back to half its pre rate ----
+        pre_rates = [d for (t, d) in buckets if t_crash - 1.0 <= t < t_crash]
+        pre_mean = (sum(pre_rates) / len(pre_rates)) if pre_rates else 0.0
+        dip_end = next(
+            (t for (t, d) in buckets
+             if t > t_crash and pre_mean > 0 and d >= 0.5 * pre_mean),
+            None,
+        )
+        metrics["dip_window_ms"] = (
+            round((dip_end - t_crash) * 1e3, 1) if dip_end is not None
+            else round(post * 1e3, 1)
+        )
+        if coord._journal is not None:
+            metrics["journal"] = dict(coord._journal.stats)
+        return metrics
+    finally:
+        sample_task.cancel()
+        for t in clients + miners:
+            t.cancel()
+        await asyncio.gather(
+            sample_task, *clients, *miners, return_exceptions=True
+        )
+        serve.cancel()
+        await asyncio.gather(serve, return_exceptions=True)
+        if state["coord"] is not None:
+            await state["coord"].close()
+        if tmpdir is not None:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def crash_check(metrics: dict) -> list:
+    """The crash scenario's pass/fail assertions (tier-1 gate shape,
+    like :func:`smoke_check`): the fleet resumed without manual
+    intervention and the answer ledger is exactly-once."""
+    bad = []
+    if metrics.get("answered", 0) <= 0:
+        bad.append(f"no requests answered at all: {metrics}")
+    if metrics.get("answers_duplicated", 0) > 0:
+        bad.append(
+            f"{metrics['answers_duplicated']} duplicate answer(s): a "
+            f"client saw the same request id answered twice"
+        )
+    if metrics.get("answers_lost", 0) > 0:
+        bad.append(
+            f"{metrics['answers_lost']} request(s) never answered "
+            f"despite the drain window"
+        )
+    if metrics.get("restart_to_first_assign_ms", 1e9) > 10_000:
+        bad.append(
+            "fleet did not resume within 10 s of the restart: "
+            f"{metrics.get('restart_to_first_assign_ms')} ms"
+        )
+    return bad
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="tpuminter control-plane load generator"
@@ -270,16 +569,42 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--smoke", action="store_true",
         help="fleet-64 burst with liveness assertions: exit 1 on any "
-        "event-loop stall >= one epoch or any lost connection",
+        "event-loop stall >= one epoch or any lost connection "
+        "(with --scenario crash: exit 1 on any lost/duplicated answer "
+        "or a fleet that fails to resume)",
+    )
+    parser.add_argument(
+        "--scenario", choices=("steady", "crash"), default="steady",
+        help="steady: the sustained-burst benchmark; crash: kill the "
+        "journaled coordinator mid-burst, restart it from the journal "
+        "on the same port, and report recovery latency plus the "
+        "exactly-once answer ledger",
+    )
+    parser.add_argument(
+        "--journal", metavar="PATH", default=None,
+        help="journal file (steady: measures journaling overhead; "
+        "crash: defaults to a temp file)",
     )
     parser.add_argument("--json", action="store_true", help="JSON output")
     args = parser.parse_args(argv)
+    if args.scenario == "crash":
+        metrics = asyncio.run(run_crash(
+            args.miners, max(2, args.clients // 2),
+            journal_path=args.journal, chunk_size=args.chunk_size,
+            pre=min(args.duration, 2.0), post=args.duration,
+        ))
+        print(json.dumps(metrics) if args.json else
+              "\n".join(f"{k}: {v}" for k, v in metrics.items()))
+        violations = crash_check(metrics) if args.smoke else []
+        for v in violations:
+            print(f"CRASH FAIL: {v}", file=sys.stderr)
+        return 1 if violations else 0
     if args.smoke:
         args.miners, args.clients = 64, 4
         args.duration = min(args.duration, 2.0)
     metrics = asyncio.run(run_load(
         args.miners, args.clients, args.duration,
-        chunk_size=args.chunk_size,
+        chunk_size=args.chunk_size, journal_path=args.journal,
     ))
     print(json.dumps(metrics) if args.json else
           "\n".join(f"{k}: {v}" for k, v in metrics.items()))

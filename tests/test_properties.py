@@ -14,7 +14,12 @@ hypothesis can shrink any violation to a minimal schedule:
   arbitrary message sizes (fragmentation boundaries included);
 - ``chain.rolled_segments`` tiles any global-index range exactly;
 - the app-protocol codec round-trips every message type, rolled
-  Requests included.
+  Requests included;
+- the coordinator journal's record stream obeys the same corruption
+  contract as the bundled frame codec (corruption/truncation can only
+  look like loss of a suffix) and its replay is idempotent.
+  (tests/test_recovery.py carries deterministic seeded versions of the
+  same properties, since this image lacks hypothesis.)
 """
 
 import random
@@ -297,6 +302,12 @@ def test_rolled_segments_tile_the_range_exactly(nonce_bits, en_lo, en_span, data
 
 _GENESIS80 = chain.GENESIS_HEADER.pack()
 
+#: Durable client identities (protocol.Request.client_key): empty =
+#: anonymous, else an opaque token that must round-trip the codec.
+_client_keys = st.one_of(
+    st.just(""), st.text(min_size=1, max_size=24)
+)
+
 plain_requests = st.builds(
     Request,
     job_id=st.integers(0, 2**31),
@@ -306,6 +317,7 @@ plain_requests = st.builds(
     header=st.just(_GENESIS80),
     target=st.integers(1, 2**256 - 1),
     chunk_id=st.integers(0, 2**31),
+    client_key=_client_keys,
 )
 
 min_requests = st.builds(
@@ -315,6 +327,7 @@ min_requests = st.builds(
     lower=st.integers(0, 1000),
     upper=st.integers(1000, 2**64 - 1),
     data=st.binary(max_size=64),
+    client_key=_client_keys,
 )
 
 rolled_requests = st.builds(
@@ -371,3 +384,80 @@ messages = st.one_of(
 @given(messages)
 def test_protocol_roundtrip(msg):
     assert decode_msg(encode_msg(msg)) == msg
+
+
+# ---------------------------------------------------------------------------
+# journal record stream (tpuminter.journal): the bundled-codec
+# corruption contract applied to disk, plus replay idempotency
+# ---------------------------------------------------------------------------
+
+from tpuminter.journal import encode_record, replay, scan  # noqa: E402
+from tpuminter.protocol import request_to_obj  # noqa: E402
+
+_journal_records = st.lists(
+    st.one_of(
+        st.builds(lambda e: {"k": "boot", "epoch": e}, st.integers(1, 50)),
+        st.builds(
+            lambda i, req: {"k": "job", "id": i, "req": request_to_obj(req)},
+            st.integers(1, 6), min_requests,
+        ),
+        st.builds(
+            lambda i, lo, size, h, s: {
+                "k": "settle", "id": i, "lo": lo, "hi": lo + size,
+                "h": f"{h:x}", "n": lo, "s": s,
+            },
+            st.integers(1, 6), st.integers(0, 900), st.integers(0, 200),
+            st.integers(0, 2**64 - 1), st.integers(1, 500),
+        ),
+        st.builds(
+            lambda i: {
+                "k": "finish", "id": i, "ckey": "c", "cjid": i,
+                "mode": "min", "n": 1, "h": "aa", "found": True, "s": 9,
+            },
+            st.integers(1, 6),
+        ),
+        st.builds(lambda i: {"k": "abandon", "id": i}, st.integers(1, 6)),
+    ),
+    min_size=1,
+    max_size=12,
+)
+
+
+@settings(max_examples=80)
+@given(_journal_records, st.data())
+def test_journal_corruption_yields_only_a_clean_prefix(records, data):
+    """Mirror of the bundled-codec property: a 1-byte flip anywhere in
+    the journal may unframe everything after it, but what DOES decode
+    is an exact prefix of the original records — corruption can only
+    look like loss of a suffix, never like different records."""
+    blob = bytearray(b"".join(encode_record(r) for r in records))
+    i = data.draw(st.integers(0, len(blob) - 1))
+    blob[i] ^= data.draw(st.integers(1, 255))
+    got, _ = scan(bytes(blob))
+    assert len(got) < len(records)
+    assert got == records[: len(got)]
+
+
+@settings(max_examples=80)
+@given(_journal_records, st.data())
+def test_journal_truncation_yields_only_a_clean_prefix(records, data):
+    blob = b"".join(encode_record(r) for r in records)
+    keep = data.draw(st.integers(0, len(blob) - 1))
+    got, clean = scan(blob[:keep])
+    assert len(got) < len(records)
+    assert got == records[: len(got)]
+    assert clean <= keep
+
+
+@settings(max_examples=60, deadline=None)
+@given(_journal_records)
+def test_journal_double_replay_idempotent(records):
+    def key(state):
+        return (
+            state.boot_epoch, state.next_job_id,
+            {j: (tuple(job.remaining), job.best, job.hashes_done)
+             for j, job in state.jobs.items()},
+            dict(state.winners),
+        )
+
+    assert key(replay(records)) == key(replay(records + records))

@@ -12,11 +12,19 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
+import secrets
+from dataclasses import replace as dc_replace
 from typing import Optional
 
 from tpuminter import chain
-from tpuminter.lsp import LspClient, LspConnectionLost, Params
-from tpuminter.lsp.params import FAST
+from tpuminter.lsp import (
+    LspClient,
+    LspConnectError,
+    LspConnectionLost,
+    Params,
+)
+from tpuminter.lsp.params import FAST, jittered_backoff
 from tpuminter.protocol import PowMode, Request, Result, decode_msg, encode_msg
 
 __all__ = ["submit", "main"]
@@ -30,22 +38,61 @@ async def submit(
     request: Request,
     *,
     params: Optional[Params] = None,
+    client_key: Optional[str] = None,
+    reconnect: bool = False,
+    base_backoff: float = 0.2,
+    max_backoff: float = 5.0,
+    rng: Optional[random.Random] = None,
 ) -> Result:
     """Connect, submit ``request``, and await its final Result.
 
     Raises :class:`LspConnectionLost` if the coordinator dies first (the
-    caller prints ``Disconnected``, matching the reference UX).
+    caller prints ``Disconnected``, matching the reference UX) — unless
+    ``reconnect`` is set, in which case the client survives coordinator
+    restarts: it redials with jittered exponential backoff and
+    RE-SUBMITS the request under its durable ``client_key`` and
+    ORIGINAL ``job_id``. A journaled coordinator deduplicates the
+    re-submission — re-binding it to the still-running recovered job,
+    or answering straight from the journaled winners table — so the
+    client gets exactly one answer no matter how many times either
+    side dies in between. ``reconnect`` without an explicit
+    ``client_key`` mints a random one for this call.
     """
-    client = await LspClient.connect(host, port, params or FAST)
-    try:
-        client.write(encode_msg(request))
-        while True:
-            msg = decode_msg(await client.read())
-            if isinstance(msg, Result) and msg.job_id == request.job_id:
-                return msg
-            log.warning("client: ignoring unexpected %s", type(msg).__name__)
-    finally:
-        await client.close(drain_timeout=2.0)
+    if client_key is None and reconnect:
+        client_key = secrets.token_hex(8)
+    if client_key:
+        request = dc_replace(request, client_key=client_key)
+    delays = jittered_backoff(base_backoff, max_backoff, rng)
+    while True:
+        try:
+            client = await LspClient.connect(host, port, params or FAST)
+        except LspConnectError:
+            if not reconnect:
+                raise
+            await asyncio.sleep(next(delays))
+            continue
+        try:
+            client.write(encode_msg(request))
+            while True:
+                msg = decode_msg(await client.read())
+                if isinstance(msg, Result) and msg.job_id == request.job_id:
+                    return msg
+                log.warning(
+                    "client: ignoring unexpected %s", type(msg).__name__
+                )
+        except LspConnectionLost:
+            if not reconnect:
+                raise
+            # the dial worked: fresh backoff episode
+            delays = jittered_backoff(base_backoff, max_backoff, rng)
+            wait = next(delays)
+            log.info(
+                "client: coordinator lost mid-job; re-submitting job %d "
+                "in %.2fs", request.job_id, wait,
+            )
+            await asyncio.sleep(wait)
+        finally:
+            await client.close(drain_timeout=2.0)
 
 
 def main(argv: Optional[list] = None) -> None:
@@ -85,6 +132,16 @@ def main(argv: Optional[list] = None) -> None:
                         "seconds (the reference blocks forever); prints "
                         "'Timeout' and exits 1, like the 'Disconnected' "
                         "path for a dead coordinator")
+    parser.add_argument("--reconnect", action="store_true",
+                        help="survive coordinator restarts: redial with "
+                        "jittered backoff and re-submit this request under "
+                        "its durable client key — a journaled coordinator "
+                        "deduplicates, so exactly one answer arrives")
+    parser.add_argument("--client-key", metavar="KEY", default=None,
+                        help="durable client identity for --reconnect "
+                        "deduplication (default: random per invocation; "
+                        "pass a stable key to dedup across client-process "
+                        "restarts too)")
     args = parser.parse_args(argv)
     if args.timeout is not None and args.timeout <= 0:
         parser.error("--timeout must be positive seconds")
@@ -155,7 +212,11 @@ def main(argv: Optional[list] = None) -> None:
             # wait_for(None) imposes no deadline — the reference's
             # block-forever default is preserved unless --timeout is given
             result = await asyncio.wait_for(
-                submit(host or "127.0.0.1", int(port), request),
+                submit(
+                    host or "127.0.0.1", int(port), request,
+                    client_key=args.client_key,
+                    reconnect=args.reconnect,
+                ),
                 args.timeout,
             )
         except asyncio.TimeoutError:

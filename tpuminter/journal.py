@@ -1,0 +1,666 @@
+"""Write-ahead job journal: durable coordinator state + crash recovery.
+
+The coordinator held every ``_Job``, chunk ledger, and acknowledged
+winner purely in memory (ISSUE 3): one process death lost all in-flight
+work — the failure the reference architecture punts on and a production
+jax_graft control plane cannot. This module is the persistence layer:
+
+**On-disk format** — an append-only file of length-prefixed,
+CRC-checksummed records (the LSP frame discipline applied to disk):
+``size:u32 ‖ crc32:u32 ‖ payload[size]``, CRC over ``size ‖ payload``,
+payload = compact JSON. A record that fails to frame or checksum ends
+the readable prefix — a torn tail and mid-file corruption are the same
+failure mode as a truncated file, exactly like the wire codec
+(tests/test_properties.py's bundled-codec properties): corruption can
+only look like *loss of a suffix*, never like different records.
+
+**Record kinds** (coordinator state transitions):
+
+- ``boot``     — one per coordinator incarnation; carries the
+  monotonically increasing boot epoch the LSP ``Connect``/connect-ack
+  exposes so a redialing peer never resumes stale sequence state.
+- ``job``      — job accepted (this is also the client-bound record:
+  the request carries the client's durable ``client_key``).
+- ``assign`` / ``requeue`` — chunk dispatched / returned to the queue.
+  Observability-only: replay derives coverage from ``settle`` records,
+  because on restart every miner is gone and every un-settled range
+  must be re-mined anyway.
+- ``settle``   — a chunk Result was verified and folded (the
+  load-bearing record: replay subtracts settled intervals from each
+  job's full range to rebuild its remaining work).
+- ``bind``     — a live job was re-bound to a reconnected client.
+  Observability-only (conn ids are ephemeral).
+- ``finish``   — winner acknowledged. The coordinator withholds the
+  client reply until this record is DURABLE (group commit + fsync), so
+  an acknowledged winner can never be lost: after a crash it is either
+  re-derivable (job replayed, re-mined) or in the winners table and
+  re-delivered when the client re-submits its request id.
+- ``abandon``  — job dropped (anonymous client died).
+- ``snapshot`` — a compacting checkpoint of the whole replayable state;
+  replay resets to it and applies subsequent records on top.
+
+**Write path** — appends buffer in memory and a flusher task group-
+commits them through the event loop's executor (``write`` + ``fsync``
+off the loop, the same discipline as PR 2's verification offload), so
+journaling never stalls epoch heartbeats. Records that gate a client
+reply pass an ``on_durable`` callback, invoked after their group's
+fsync returns. With no running loop (unit-level drives) appends write
+through synchronously.
+
+**Replay** is a pure function (:func:`replay`) over decoded records and
+is idempotent: replaying a journal twice — or a snapshot plus the
+records it already covers — yields the same recovered state (settles
+subtract intervals and min-fold; job/finish/abandon are guarded by id).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import time
+import zlib
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+import asyncio
+
+import logging
+
+from tpuminter.protocol import Request, request_from_obj, request_to_obj
+
+log = logging.getLogger("tpuminter.journal")
+
+__all__ = [
+    "Journal",
+    "RecoveredJob",
+    "RecoveredState",
+    "encode_record",
+    "scan",
+    "replay",
+    "merge_ranges",
+    "subtract_range",
+    "WINNERS_CAP",
+]
+
+_REC = struct.Struct("<II")
+
+#: Framing bound: no honest record approaches this (the largest — a
+#: snapshot of a busy coordinator — is a few hundred kB); a corrupted
+#: size field past it ends the readable prefix instead of attempting a
+#: gigabyte allocation.
+MAX_RECORD = 8 << 20
+
+#: Acknowledged winners retained for duplicate-request suppression
+#: (both live and across restarts); oldest evicted beyond this.
+WINNERS_CAP = 4096
+
+#: A durable group commit whose write+fsync completes under this bound
+#: runs INLINE on the event loop (this host measures ~0.15 ms — far
+#: cheaper than an executor round trip's thread handoffs on one core);
+#: the first commit that exceeds it flips the journal to executor
+#: offload for good (a slow/contended disk must never stall epoch
+#: heartbeats).
+INLINE_FSYNC_BUDGET_S = 0.002
+
+#: How long a callback-free batch may sit buffered so more records can
+#: pile onto one ``write`` (the ACK_DELAY_S move applied to disk). A
+#: batch holding a durability callback is never delayed by this.
+BATCH_WINDOW_S = 0.002
+
+
+# ---------------------------------------------------------------------------
+# record codec (pure)
+# ---------------------------------------------------------------------------
+
+def frame_payload(payload: bytes) -> bytes:
+    """Frame one already-serialized JSON payload:
+    ``size ‖ crc32(size ‖ payload) ‖ payload``."""
+    size = len(payload)
+    if size > MAX_RECORD:
+        raise ValueError(f"record too large: {size} > {MAX_RECORD}")
+    head = struct.pack("<I", size)
+    crc = zlib.crc32(payload, zlib.crc32(head))
+    return _REC.pack(size, crc) + payload
+
+
+def encode_record(obj: dict) -> bytes:
+    """Serialize one record dict (see :func:`frame_payload`)."""
+    return frame_payload(json.dumps(obj, separators=(",", ":")).encode())
+
+
+def scan(data: bytes) -> Tuple[List[dict], int]:
+    """Decode the valid record prefix of ``data``.
+
+    Returns ``(records, clean_bytes)`` where ``clean_bytes`` is the
+    length of the prefix that framed and checksummed; everything past it
+    (a torn tail, a corrupted record, and whatever its broken size field
+    would have unframed) is treated as lost — the recovery caller
+    truncates the file there.
+    """
+    records: List[dict] = []
+    off = 0
+    total = len(data)
+    while total - off >= _REC.size:
+        size, crc = _REC.unpack_from(data, off)
+        end = off + _REC.size + size
+        if size > MAX_RECORD or end > total:
+            break
+        payload = bytes(data[off + _REC.size : end])
+        if crc != zlib.crc32(payload, zlib.crc32(data[off : off + 4])):
+            break
+        try:
+            obj = json.loads(payload)
+        except ValueError:
+            break
+        if not isinstance(obj, dict) or "k" not in obj:
+            break
+        records.append(obj)
+        off = end
+    return records, off
+
+
+# ---------------------------------------------------------------------------
+# interval arithmetic (pure)
+# ---------------------------------------------------------------------------
+
+def merge_ranges(ranges: List[Tuple[int, int]]) -> List[Tuple[int, int]]:
+    """Sort + coalesce inclusive integer intervals (adjacency merges)."""
+    out: List[Tuple[int, int]] = []
+    for lo, hi in sorted(r for r in ranges if r[1] >= r[0]):
+        if out and lo <= out[-1][1] + 1:
+            out[-1] = (out[-1][0], max(out[-1][1], hi))
+        else:
+            out.append((lo, hi))
+    return out
+
+
+def subtract_range(
+    ranges: List[Tuple[int, int]], lo: int, hi: int
+) -> Tuple[List[Tuple[int, int]], int]:
+    """Remove ``[lo, hi]`` from a list of disjoint inclusive intervals.
+
+    Returns ``(new_ranges, removed)`` where ``removed`` counts the
+    nonces actually removed — zero when the settle was already applied,
+    which is what makes replay idempotent (the second application of a
+    duplicated record subtracts nothing and books no work).
+    """
+    out: List[Tuple[int, int]] = []
+    removed = 0
+    for a, b in ranges:
+        if b < lo or a > hi:
+            out.append((a, b))
+            continue
+        cut_lo, cut_hi = max(a, lo), min(b, hi)
+        removed += cut_hi - cut_lo + 1
+        if a < cut_lo:
+            out.append((a, cut_lo - 1))
+        if cut_hi < b:
+            out.append((cut_hi + 1, b))
+    return out, removed
+
+
+# ---------------------------------------------------------------------------
+# replay (pure)
+# ---------------------------------------------------------------------------
+
+def _best_to_obj(best: Optional[Tuple[int, int]]):
+    return None if best is None else [f"{best[0]:x}", best[1]]
+
+
+def _best_from_obj(obj) -> Optional[Tuple[int, int]]:
+    return None if obj is None else (int(obj[0], 16), int(obj[1]))
+
+
+@dataclass
+class RecoveredJob:
+    """One journaled job replayed back to its pre-crash coverage."""
+
+    job_id: int
+    request: Request
+    #: un-settled inclusive intervals of the job's full range — the work
+    #: a restarted coordinator must still dispatch
+    remaining: List[Tuple[int, int]]
+    best: Optional[Tuple[int, int]] = None  # (hash_value, nonce) min-fold
+    hashes_done: int = 0
+
+    @property
+    def client_key(self) -> str:
+        return self.request.client_key
+
+    @property
+    def client_job_id(self) -> int:
+        return self.request.job_id
+
+    def to_obj(self) -> dict:
+        return {
+            "id": self.job_id,
+            "req": request_to_obj(self.request),
+            "rem": [[lo, hi] for lo, hi in self.remaining],
+            "best": _best_to_obj(self.best),
+            "hashes": self.hashes_done,
+        }
+
+    @classmethod
+    def from_obj(cls, obj: dict) -> "RecoveredJob":
+        return cls(
+            job_id=int(obj["id"]),
+            request=request_from_obj(obj["req"]),
+            remaining=merge_ranges(
+                [(int(lo), int(hi)) for lo, hi in obj["rem"]]
+            ),
+            best=_best_from_obj(obj.get("best")),
+            hashes_done=int(obj.get("hashes", 0)),
+        )
+
+
+@dataclass
+class RecoveredState:
+    """Everything :func:`replay` rebuilds from a journal."""
+
+    boot_epoch: int = 0
+    next_job_id: int = 1
+    jobs: Dict[int, RecoveredJob] = field(default_factory=dict)
+    #: (client_key, client_job_id) → finish-record dict, oldest first
+    winners: "OrderedDict[Tuple[str, int], dict]" = field(
+        default_factory=OrderedDict
+    )
+    #: job ids seen finishing/abandoned — guards job-record idempotency
+    finished: Set[int] = field(default_factory=set)
+    records: int = 0
+
+    def apply(self, rec: dict) -> None:
+        k = rec["k"]
+        self.records += 1
+        if k == "boot":
+            self.boot_epoch = max(self.boot_epoch, int(rec["epoch"]))
+        elif k == "snapshot":
+            self.next_job_id = int(rec["next"])
+            self.jobs = {
+                int(j["id"]): RecoveredJob.from_obj(j) for j in rec["jobs"]
+            }
+            self.winners = OrderedDict(
+                ((str(ck), int(cj)), dict(w))
+                for ck, cj, w in rec["winners"]
+            )
+            # post-snapshot records can only re-apply state the snapshot
+            # already contains (complete job+finish pairs or finish-only
+            # tails), so the guard restarts empty
+            self.finished = set()
+        elif k == "job":
+            job_id = int(rec["id"])
+            self.next_job_id = max(self.next_job_id, job_id + 1)
+            if job_id in self.jobs or job_id in self.finished:
+                return  # duplicate (double replay): already accounted
+            req = request_from_obj(rec["req"])
+            self.jobs[job_id] = RecoveredJob(
+                job_id=job_id, request=req,
+                remaining=[(req.lower, req.upper)],
+            )
+        elif k == "settle":
+            job = self.jobs.get(int(rec["id"]))
+            if job is None:
+                return  # job finished/abandoned/unknown: moot
+            job.remaining, removed = subtract_range(
+                job.remaining, int(rec["lo"]), int(rec["hi"])
+            )
+            if removed:
+                job.hashes_done += int(rec["s"])
+            claim = (int(rec["h"], 16), int(rec["n"]))
+            if job.best is None or claim < job.best:
+                job.best = claim  # min-fold: idempotent under replay
+        elif k == "finish":
+            job_id = int(rec["id"])
+            self.jobs.pop(job_id, None)
+            self.finished.add(job_id)
+            ckey = rec.get("ckey") or ""
+            if ckey:
+                key = (ckey, int(rec["cjid"]))
+                self.winners.pop(key, None)
+                self.winners[key] = rec
+                while len(self.winners) > WINNERS_CAP:
+                    self.winners.popitem(last=False)
+        elif k == "abandon":
+            job_id = int(rec["id"])
+            self.jobs.pop(job_id, None)
+            self.finished.add(job_id)
+        # assign / requeue / bind: observability records; coverage is
+        # derived from settles (every un-settled range re-mines anyway)
+
+    def snapshot_obj(self) -> dict:
+        """The compacting checkpoint equivalent to this state (minus the
+        boot epoch, which compaction writes as its own ``boot`` record)."""
+        return {
+            "k": "snapshot",
+            "next": self.next_job_id,
+            "jobs": [j.to_obj() for j in self.jobs.values()],
+            "winners": [
+                [ck, cj, w] for (ck, cj), w in self.winners.items()
+            ],
+        }
+
+
+def replay(records: List[dict]) -> RecoveredState:
+    """Fold a record sequence into a :class:`RecoveredState` (pure,
+    idempotent: ``replay(r + r)`` equals ``replay(r)``)."""
+    state = RecoveredState()
+    for rec in records:
+        state.apply(rec)
+    return state
+
+
+# ---------------------------------------------------------------------------
+# the journal itself (runtime)
+# ---------------------------------------------------------------------------
+
+class Journal:
+    """Append-only WAL with batched group commit and compaction.
+
+    Use :meth:`open` — it scans the existing file (truncating any torn
+    tail in place), replays it, bumps the boot epoch, and durably writes
+    the new ``boot`` record before returning, so the caller's LSP server
+    never advertises an epoch a crash could roll back.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        *,
+        fsync: bool = True,
+        compact_bytes: int = 4 << 20,
+    ):
+        self.path = path
+        self._fsync = fsync
+        self._compact_bytes = compact_bytes
+        self._fh = None
+        self._buffer: List[Tuple[dict, Optional[Callable[[], None]]]] = []
+        self._flush_task: Optional[asyncio.Task] = None
+        self._closed = False
+        self._crashed = False
+        #: the disk failed mid-flight (ENOSPC, yanked volume, ...):
+        #: journaling stops, but durability callbacks keep firing so
+        #: client replies are never wedged behind a dead WAL — the
+        #: coordinator keeps serving, loudly undurable
+        self._failed = False
+        self.boot_epoch = 0
+        #: coordinator-provided callable returning the snapshot record
+        #: (``RecoveredState.snapshot_obj`` shape); compaction is skipped
+        #: while unset
+        self.snapshot_provider: Optional[Callable[[], dict]] = None
+        self._bytes_since_compact = 0
+        self._fsync_slow = False  # sticky: see INLINE_FSYNC_BUDGET_S
+        self.stats = {
+            "records": 0,
+            "flushes": 0,
+            "syncs": 0,
+            "bytes": 0,
+            "compactions": 0,
+        }
+
+    # -- construction ----------------------------------------------------
+
+    @classmethod
+    def open(cls, path: str, **kwargs) -> Tuple["Journal", RecoveredState]:
+        """Open (or create) the journal at ``path`` and replay it."""
+        records: List[dict] = []
+        if os.path.exists(path):
+            with open(path, "rb") as fh:
+                data = fh.read()
+            records, clean = scan(data)
+            if clean < len(data):
+                # torn tail / corrupt record: drop the unreadable suffix
+                # in place so the file is a clean prefix again
+                with open(path, "r+b") as fh:
+                    fh.truncate(clean)
+        state = replay(records)
+        state.boot_epoch += 1
+        journal = cls(path, **kwargs)
+        journal.boot_epoch = state.boot_epoch
+        journal._fh = open(path, "ab")
+        # the boot record is durable BEFORE the server advertises the
+        # epoch: a crash right after startup must not reuse it
+        journal._write_sync(
+            encode_record({"k": "boot", "epoch": state.boot_epoch}), True
+        )
+        journal.stats["records"] += 1
+        return journal, state
+
+    # -- append path -----------------------------------------------------
+
+    def append(
+        self,
+        kind: str,
+        obj: Optional[dict] = None,
+        *,
+        on_durable: Optional[Callable[[], None]] = None,
+    ) -> None:
+        """Queue one record for the next group commit. ``on_durable``
+        fires after the record's group has been fsynced (the seam the
+        coordinator's winner acknowledgement hangs off).
+
+        Durability is tiered, which is what keeps the overhead off the
+        hot path: a group is fsynced only when a record in it carries
+        an ``on_durable`` callback (winner acknowledgements). Routine
+        records (settle/assign/requeue) are written+flushed but ride
+        to disk with the next sync or the OS's own writeback — losing
+        a tail of them in a crash is exactly the suffix loss replay
+        already tolerates (the un-settled ranges re-mine)."""
+        if self._closed or self._crashed or self._failed:
+            # a record can be dropped; a reply waiting on it cannot —
+            # fire the callback now (durability is already lost and
+            # was logged loudly when the journal died)
+            if on_durable is not None and not self._crashed:
+                on_durable()
+            return
+        rec = dict(obj or {})
+        rec["k"] = kind
+        self._buffer.append((rec, on_durable))
+        self.stats["records"] += 1
+        self._kick()
+
+    def append_encoded(self, payload: bytes) -> None:
+        """Hot-path variant: the caller hands the record's JSON payload
+        pre-built (``b'{...,"k":"settle"}'``). Skips the dict + dumps
+        round trip — measured ~2 µs/record on the fleet-8 settle storm,
+        the journal's highest-rate record."""
+        if self._closed or self._crashed or self._failed:
+            return
+        self._buffer.append((payload, None))
+        self.stats["records"] += 1
+        self._kick()
+
+    def _kick(self) -> None:
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            # no loop (unit-level drives): write through synchronously
+            self._flush_buffered_sync()
+            return
+        if self._flush_task is None or self._flush_task.done():
+            self._flush_task = asyncio.ensure_future(self._flush_loop())
+
+    @staticmethod
+    def _encode_batch(buf) -> bytes:
+        return b"".join(
+            frame_payload(rec) if isinstance(rec, bytes)
+            else encode_record(rec)
+            for rec, _ in buf
+        )
+
+    def _flush_buffered_sync(self) -> None:
+        buf, self._buffer = self._buffer, []
+        if not buf:
+            return
+        self._write_sync(self._encode_batch(buf), True)
+        for _, cb in buf:
+            if cb is not None:
+                cb()
+
+    async def _flush_loop(self) -> None:
+        """Group-commit everything buffered; one task per burst
+        (re-kicked by the next append).
+
+        Two tiers, measured on the loadgen fleet-8 run: a batch with no
+        durability callbacks is a buffered page-cache ``write`` — a few
+        microseconds — and runs INLINE on the loop (an executor round
+        trip costs more in thread handoffs on a busy 1-core host than
+        the write itself). A batch gating a winner acknowledgement
+        needs ``fsync``, which CAN stall for milliseconds, so that tier
+        goes through the executor — the loop never blocks on disk
+        flush, same discipline as the verification offload."""
+        loop = asyncio.get_running_loop()
+        while self._buffer and not self._crashed and not self._closed:
+            if all(cb is None for _, cb in self._buffer):
+                # no durability callback waiting: let the burst
+                # grow for one batch window — one write per window
+                # instead of one per event-loop tick
+                await asyncio.sleep(BATCH_WINDOW_S)
+            buf, self._buffer = self._buffer, []
+            if not buf:
+                continue
+            need_sync = any(cb is not None for _, cb in buf)
+            try:
+                if need_sync and self._fsync and self._fsync_slow:
+                    await loop.run_in_executor(
+                        None, self._encode_write_sync, buf, True
+                    )
+                elif need_sync and self._fsync:
+                    # fast-disk fsync runs inline (INLINE_FSYNC_BUDGET_S)
+                    t0 = time.perf_counter()
+                    self._encode_write_sync(buf, True)
+                    if time.perf_counter() - t0 > INLINE_FSYNC_BUDGET_S:
+                        self._fsync_slow = True
+                    await asyncio.sleep(0)
+                else:
+                    self._encode_write_sync(buf, False)
+                    # yield one tick so the next burst batches up
+                    await asyncio.sleep(0)
+            except (OSError, ValueError):
+                # the disk died under us (ENOSPC, yanked volume). The
+                # batch is already detached from the buffer: its
+                # durability is unrecoverable, but the replies gated on
+                # it must NOT be — fire the callbacks (availability
+                # over durability, announced loudly) and stop
+                # journaling; later appends short-circuit the same way.
+                if self._crashed:
+                    return
+                self._failed = True
+                log.exception(
+                    "journal write to %s FAILED — journaling disabled, "
+                    "durability is LOST for this incarnation; replies "
+                    "continue undurable", self.path,
+                )
+            for _, cb in buf:
+                if cb is not None:
+                    try:
+                        cb()
+                    except Exception:  # a callback must not kill the WAL
+                        pass
+            if self._failed:
+                # drain callbacks still in the buffer the same way,
+                # then stop journaling for good
+                rest, self._buffer = self._buffer, []
+                for _, cb in rest:
+                    if cb is not None:
+                        try:
+                            cb()
+                        except Exception:
+                            pass
+                return
+            if (
+                self.snapshot_provider is not None
+                and self._bytes_since_compact > self._compact_bytes
+            ):
+                # the snapshot is taken ON the loop (it reads live
+                # coordinator state and therefore covers everything
+                # appended so far — replay idempotency absorbs the
+                # records that land both in it and after it); only
+                # the file swap runs in the executor
+                snap = self.snapshot_provider()
+                blob = encode_record(
+                    {"k": "boot", "epoch": self.boot_epoch}
+                ) + encode_record(snap)
+                try:
+                    await loop.run_in_executor(
+                        None, self._compact_sync, blob
+                    )
+                except (OSError, ValueError):
+                    if self._crashed:
+                        return
+                    self._failed = True
+                    log.exception(
+                        "journal compaction of %s FAILED — journaling "
+                        "disabled for this incarnation", self.path,
+                    )
+                    return
+
+    def _encode_write_sync(self, buf, need_sync: bool) -> None:
+        self._write_sync(self._encode_batch(buf), need_sync)
+
+    def _write_sync(self, blob: bytes, need_sync: bool) -> None:
+        if self._crashed:
+            return
+        self._fh.write(blob)
+        self._fh.flush()
+        if self._fsync and need_sync:
+            os.fsync(self._fh.fileno())
+            self.stats["syncs"] += 1
+        self.stats["flushes"] += 1
+        self.stats["bytes"] += len(blob)
+        self._bytes_since_compact += len(blob)
+
+    def _compact_sync(self, blob: bytes) -> None:
+        if self._crashed:
+            return
+        tmp = self.path + ".compact"
+        with open(tmp, "wb") as fh:
+            fh.write(blob)
+            fh.flush()
+            if self._fsync:
+                os.fsync(fh.fileno())
+        os.replace(tmp, self.path)
+        self._fh.close()
+        self._fh = open(self.path, "ab")
+        self._bytes_since_compact = 0
+        self.stats["compactions"] += 1
+
+    async def flush(self) -> None:
+        """Drain the buffer (tests; close uses it too)."""
+        while self._buffer or (
+            self._flush_task is not None and not self._flush_task.done()
+        ):
+            self._kick()
+            if self._flush_task is not None:
+                await asyncio.gather(self._flush_task, return_exceptions=True)
+            if not self._buffer:
+                break
+
+    async def aclose(self) -> None:
+        """Graceful close: final group commit, then release the file."""
+        if self._closed or self._crashed:
+            return
+        if not self._failed:
+            await self.flush()
+        self._closed = True
+        try:
+            if not self._failed:
+                self._flush_buffered_sync()
+        finally:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+
+    def crash(self) -> None:
+        """Fault-injection seam: die like ``kill -9`` — buffered records
+        are LOST (they gated no client reply yet, so exactly-once
+        survives), nothing more is flushed, the fd just closes."""
+        self._crashed = True
+        self._buffer.clear()
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass

@@ -11,7 +11,15 @@ from typing import Optional, Tuple, Union
 
 import tpuminter.lsp as lsp
 from tpuminter.lsp.connection import ACK_DELAY_S, ConnState
-from tpuminter.lsp.message import Frame, MsgType, decode_all, encode
+from tpuminter.lsp.message import (
+    EPOCH_CONNECT,
+    EPOCH_RESET,
+    Frame,
+    MsgType,
+    decode_all,
+    decode_epoch,
+    encode,
+)
 from tpuminter.lsp.params import Params
 from tpuminter.lsp.transport import UdpEndpoint
 
@@ -36,6 +44,10 @@ class LspClient:
         self._epoch_task: Optional[asyncio.Task] = None
         self._lost_reason: Optional[str] = None
         self._ack_flush_scheduled = False
+        #: the server incarnation this session belongs to (boot epoch
+        #: from the connect-ack); roles compare it across redials to
+        #: tell "same coordinator" from "restarted coordinator"
+        self._server_epoch = 0
 
     # -- construction ----------------------------------------------------
 
@@ -73,7 +85,9 @@ class LspClient:
                     timeout=self._params.epoch_seconds,
                 )
                 if self._connect_waiter.done():
-                    conn_id = self._connect_waiter.result()
+                    conn_id, self._server_epoch = (
+                        self._connect_waiter.result()
+                    )
                     break
             else:
                 raise lsp.LspConnectError(
@@ -111,15 +125,57 @@ class LspClient:
 
     def _on_datagram(self, data: bytes, addr: Tuple[str, int]) -> None:
         for frame in decode_all(data):
+            epoch_info = (
+                decode_epoch(frame.payload)
+                if frame.type == MsgType.ACK and frame.seq == 0
+                and frame.payload else None
+            )
             if self._conn is None:
-                # handshake phase: the connect-ack is ACK seq 0 with our id
+                # handshake phase: the connect-ack is ACK seq 0 with our
+                # id and (modern servers) the boot-epoch payload
                 if (
                     frame.type == MsgType.ACK
                     and frame.seq == 0
+                    and (epoch_info is None or epoch_info[0] == EPOCH_CONNECT)
                     and self._connect_waiter is not None
                     and not self._connect_waiter.done()
                 ):
-                    self._connect_waiter.set_result(frame.conn_id)
+                    self._connect_waiter.set_result(
+                        (frame.conn_id,
+                         epoch_info[1] if epoch_info else 0)
+                    )
+                continue
+            if epoch_info is not None:
+                # epoch-stamped seq-0 ack, never fed to ConnState (its
+                # payload is not SACK words). A RESET means the server
+                # does not know this connection — it restarted or
+                # already forgot us; a CONNECT ack for a DIFFERENT
+                # epoch means the server restarted between our
+                # handshake and now. Either way the session is over:
+                # stale sequence state must never be resumed against a
+                # new incarnation. A duplicate connect-ack for OUR
+                # epoch (dup/reordered handshake datagram) is ignored.
+                #
+                # server_epoch == 0 means we never LEARNED the epoch:
+                # under loss the stamped connect-ack can be dropped and
+                # a plain heartbeat pad completes the handshake instead
+                # (the heartbeat proves the conn exists server-side).
+                # The first stamped ack then teaches the epoch — it
+                # must not read as a restart (observed: the chaos/fuzz
+                # drop suites killing healthy connections "0 -> N").
+                kind, epoch = epoch_info
+                if kind == EPOCH_RESET:
+                    self._conn.declare_lost(
+                        "server restarted or forgot this connection "
+                        "(reset ack)"
+                    )
+                elif self._server_epoch == 0:
+                    self._server_epoch = epoch
+                elif epoch != self._server_epoch:
+                    self._conn.declare_lost(
+                        "server restarted "
+                        f"(boot epoch {self._server_epoch} -> {epoch})"
+                    )
                 continue
             if frame.conn_id == self._conn.conn_id:
                 self._conn.on_frame(frame)
@@ -166,6 +222,14 @@ class LspClient:
     def conn_id(self) -> int:
         assert self._conn is not None
         return self._conn.conn_id
+
+    @property
+    def server_epoch(self) -> int:
+        """The server incarnation's boot epoch, from the connect-ack
+        (0 against a pre-epoch server). A redialing role compares this
+        across sessions: a changed epoch means a restarted coordinator
+        — fresh session, re-Join / re-submit everything."""
+        return self._server_epoch
 
     @property
     def is_lost(self) -> bool:

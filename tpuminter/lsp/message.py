@@ -32,6 +32,38 @@ class MsgType(IntEnum):
     ACK = 2      # acks DATA seq; seq 0 = connect-ack / heartbeat
 
 
+#: Boot-epoch payloads ride seq-0 ACK frames (ISSUE 3 satellite: a
+#: peer redialing a coordinator restarted on the same port must treat
+#: it as a fresh session, never resume stale sequence state). The
+#: payload is ``magic:u8 ‖ epoch:u64`` — 9 bytes, deliberately NOT a
+#: multiple of 4, so it can never be confused with the SACK payload
+#: (u32 words) a data-bearing ACK carries.
+_EPOCH = struct.Struct("<BQ")
+
+#: connect-ack: "your connection is accepted; this incarnation's epoch"
+EPOCH_CONNECT = 0xE7
+#: reset: "I don't know this connection" — sent to frames from unknown
+#: addresses so a peer of a previous incarnation learns of the restart
+#: in one round trip instead of an epoch-limit timeout
+EPOCH_RESET = 0xE8
+
+
+def encode_epoch(kind: int, epoch: int) -> bytes:
+    """Build a seq-0 ACK epoch payload (connect-ack or reset)."""
+    return _EPOCH.pack(kind, epoch)
+
+
+def decode_epoch(payload) -> Optional[tuple]:
+    """Parse an epoch payload; ``(kind, epoch)`` or None when the
+    payload is anything else (empty heartbeat, SACK words)."""
+    if len(payload) != _EPOCH.size:
+        return None
+    kind, epoch = _EPOCH.unpack(payload)
+    if kind not in (EPOCH_CONNECT, EPOCH_RESET):
+        return None
+    return kind, epoch
+
+
 @dataclass(frozen=True)
 class Frame:
     type: MsgType

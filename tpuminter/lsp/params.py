@@ -8,8 +8,9 @@ included because the roles layer wants them in practice.
 
 from __future__ import annotations
 
+import random
 from dataclasses import dataclass
-from typing import Optional
+from typing import Iterator, Optional
 
 
 @dataclass(frozen=True)
@@ -39,6 +40,22 @@ class Params:
     @property
     def epoch_seconds(self) -> float:
         return self.epoch_millis / 1000.0
+
+
+def jittered_backoff(
+    base: float, cap: float, rng: Optional[random.Random] = None
+) -> Iterator[float]:
+    """Yield reconnect delays: ``base · 2^k`` capped at ``cap``, each
+    scaled by a uniform [0.5, 1.5) jitter so a fleet killed by one
+    coordinator crash does not redial in lockstep. One generator per
+    reconnect episode — make a fresh one after a successful session to
+    reset the backoff. The single implementation behind every redial
+    loop (worker, client, loadgen actors)."""
+    rng = rng or random.Random()
+    delay = base
+    while True:
+        yield delay * (0.5 + rng.random())
+        delay = min(delay * 2, cap)
 
 
 #: Snappy settings used by the mining roles and most tests (the reference's

@@ -10,12 +10,25 @@ coordinator's failure recovery hangs off (SURVEY.md §3.3).
 from __future__ import annotations
 
 import asyncio
+import random
 from typing import Dict, Optional, Tuple
 
 from tpuminter.lsp.connection import ACK_DELAY_S, ConnState
-from tpuminter.lsp.message import Frame, MsgType, decode_all, encode
+from tpuminter.lsp.message import (
+    EPOCH_CONNECT,
+    EPOCH_RESET,
+    Frame,
+    MsgType,
+    decode_all,
+    encode,
+    encode_epoch,
+)
 from tpuminter.lsp.params import Params
 from tpuminter.lsp.transport import Addr, UdpEndpoint
+
+#: Reset-ack replies to unknown-address traffic per epoch tick — bounds
+#: the amplification a spoofed-source datagram storm could extract.
+_MAX_RESETS_PER_EPOCH = 256
 
 
 class LspServer:
@@ -38,6 +51,13 @@ class LspServer:
         # survives connection churn
         self._acks_sent_closed = 0
         self._acks_coalesced_closed = 0
+        #: this incarnation's identity (ISSUE 3): carried in every
+        #: connect-ack so a redialing peer can tell a restarted server
+        #: from the one it left, and in reset acks to unknown addresses
+        #: so a stale peer learns of the restart without waiting out
+        #: its epoch-limit
+        self._boot_epoch = 0
+        self._reset_pinged: set = set()  # addrs reset-acked this epoch
 
     @classmethod
     async def create(
@@ -47,9 +67,17 @@ class LspServer:
         *,
         host: str = "127.0.0.1",
         seed: Optional[int] = None,
+        boot_epoch: Optional[int] = None,
     ) -> "LspServer":
         self = cls()
         self._params = params or Params()
+        # journaled owners pass their durable monotone epoch; everyone
+        # else gets a random nonzero one — distinct across restarts with
+        # 2^-63 collision odds, which is all the detection needs
+        self._boot_epoch = (
+            boot_epoch if boot_epoch is not None
+            else (random.getrandbits(63) | 1)
+        )
         self._endpoint = await UdpEndpoint.create(
             self._on_datagram, local_addr=(host, port), seed=seed
         )
@@ -60,17 +88,41 @@ class LspServer:
 
     def _on_datagram(self, data: bytes, addr: Addr) -> None:
         conn = self._by_addr.get(addr)
+        stale_conn_id: Optional[int] = None
         for frame in decode_all(data):
             if frame.type == MsgType.CONNECT:
                 if conn is None:
                     conn = self._new_conn(addr)
                 # (re-)ack the handshake; duplicate CONNECTs mean our
-                # ack was lost
-                self._send_to(addr, Frame(MsgType.ACK, conn.conn_id, 0))
+                # ack was lost. The ack carries this incarnation's boot
+                # epoch so the peer can tell a restart from a redial.
+                self._send_to(addr, Frame(
+                    MsgType.ACK, conn.conn_id, 0,
+                    encode_epoch(EPOCH_CONNECT, self._boot_epoch),
+                ))
                 conn.on_frame(frame)
             elif conn is not None and frame.conn_id == conn.conn_id:
                 conn.on_frame(frame)
-            # frames for unknown/stale connections are dropped
+            elif conn is None:
+                # traffic from an address we don't know: a peer of a
+                # previous incarnation (we restarted) or one we already
+                # forgot (we closed it). Answer with a reset ack below.
+                stale_conn_id = frame.conn_id
+            # frames for a known addr with a mismatched conn_id dropped
+        if conn is None and stale_conn_id is not None:
+            # one reset per addr per epoch (plus a global cap): the peer
+            # retransmits anyway, and an unreachable-epoch storm must
+            # not turn into an ack storm
+            if (
+                addr not in self._reset_pinged
+                and len(self._reset_pinged) < _MAX_RESETS_PER_EPOCH
+            ):
+                self._reset_pinged.add(addr)
+                self._send_to(addr, Frame(
+                    MsgType.ACK, stale_conn_id, 0,
+                    encode_epoch(EPOCH_RESET, self._boot_epoch),
+                ))
+            return
         if conn is not None and conn.acks_pending:
             if conn.ack_urgent:
                 # a window-blocked sender mid-fragmented-message cannot
@@ -148,6 +200,7 @@ class LspServer:
     async def _epoch_loop(self) -> None:
         while True:
             await asyncio.sleep(self._params.epoch_seconds)
+            self._reset_pinged.clear()
             for conn in list(self._by_id.values()):
                 conn.on_epoch()
 
@@ -157,6 +210,11 @@ class LspServer:
     def port(self) -> int:
         assert self._endpoint is not None
         return self._endpoint.local_addr[1]
+
+    @property
+    def boot_epoch(self) -> int:
+        """This incarnation's identity (see ``message.EPOCH_CONNECT``)."""
+        return self._boot_epoch
 
     @property
     def conn_ids(self) -> Tuple[int, ...]:
@@ -212,6 +270,19 @@ class LspServer:
             self._forget(conn_id)
         else:
             asyncio.ensure_future(_reap())
+
+    def crash(self) -> None:
+        """Fault-injection seam: die like ``kill -9`` — the socket
+        closes with no drain and the epoch loop stops. Unlike
+        :meth:`close`, nothing is flushed and no peer gets a goodbye;
+        unlike just closing the endpoint, the epoch task does not
+        outlive the incarnation (it would otherwise keep ticking dead
+        connections for process life — one immortal task per simulated
+        crash in the recovery harnesses)."""
+        if self._epoch_task is not None:
+            self._epoch_task.cancel()
+        if self._endpoint is not None:
+            self._endpoint.close()
 
     async def close(self, drain_timeout: Optional[float] = None) -> None:
         """Close all connections, draining in-flight data first (bounded by

@@ -45,6 +45,8 @@ __all__ = [
     "Message",
     "encode_msg",
     "decode_msg",
+    "request_to_obj",
+    "request_from_obj",
     "ProtocolError",
     "MIN_UNTRACKED",
 ]
@@ -118,6 +120,16 @@ class Request:
     ``branch``. ``nonce_bits`` is 32 in production; tests shrink it so a
     roll happens within a tractable sweep. Workers perform the roll on
     device (``ops.merkle.make_extranonce_roll``).
+
+    ``client_key`` is a durable client identity (any opaque string the
+    client chooses once and reuses across reconnects). Connection ids
+    are ephemeral — a coordinator restart or a client redial mints new
+    ones — so exactly-once answers across either failure need a key
+    that survives both: a re-submitted ``(client_key, job_id)`` is
+    deduplicated against the journaled winners table or re-bound to the
+    still-running job instead of spawning a duplicate (see
+    ``tpuminter.journal``). Empty (the default) opts out: anonymous
+    jobs keep the reference's connection-scoped lifetime.
     """
 
     job_id: int
@@ -133,6 +145,7 @@ class Request:
     extranonce_size: int = 4
     branch: Tuple[bytes, ...] = ()
     nonce_bits: int = 32
+    client_key: str = ""
 
     @property
     def rolled(self) -> bool:
@@ -278,6 +291,8 @@ def _request_obj(msg: Request) -> dict:
         obj["en_size"] = msg.extranonce_size
         obj["branch"] = [sib.hex() for sib in msg.branch]
         obj["nonce_bits"] = msg.nonce_bits
+    if msg.client_key:
+        obj["ckey"] = msg.client_key
     return obj
 
 
@@ -298,7 +313,16 @@ def _request_from_obj(obj: dict) -> Request:
         extranonce_size=int(obj.get("en_size", 4)),
         branch=tuple(bytes.fromhex(s) for s in obj.get("branch", [])),
         nonce_bits=int(obj.get("nonce_bits", 32)),
+        client_key=str(obj.get("ckey", "")),
     )
+
+
+#: Public names for the Request ↔ JSON-object codec: the journal
+#: (``tpuminter.journal``) persists job templates through the same
+#: codec the wire uses, so replayed Requests are bit-equal to received
+#: ones.
+request_to_obj = _request_obj
+request_from_obj = _request_from_obj
 
 
 def encode_msg(msg: Message) -> bytes:

@@ -24,11 +24,13 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import struct
 from typing import Callable, Iterator, Optional
 
 from tpuminter import chain
-from tpuminter.lsp import LspClient, LspConnectionLost, Params
+from tpuminter.lsp import LspClient, LspConnectError, LspConnectionLost, Params
+from tpuminter.lsp.params import jittered_backoff
 from tpuminter.lsp.params import FAST
 from dataclasses import replace as dc_replace
 
@@ -47,7 +49,10 @@ from tpuminter.protocol import (
     encode_msg,
 )
 
-__all__ = ["Miner", "CpuMiner", "ProfiledMiner", "run_miner", "main"]
+__all__ = [
+    "Miner", "CpuMiner", "ProfiledMiner", "run_miner",
+    "run_miner_reconnect", "main",
+]
 
 log = logging.getLogger("tpuminter.worker")
 
@@ -390,6 +395,56 @@ async def run_miner(
         await client.close(drain_timeout=2.0)
 
 
+async def run_miner_reconnect(
+    host: str,
+    port: int,
+    miner: Miner,
+    *,
+    params: Optional[Params] = None,
+    on_result: Optional[Callable[[Result], None]] = None,
+    base_backoff: float = 0.2,
+    max_backoff: float = 5.0,
+    max_dials: Optional[int] = None,
+    rng: Optional[random.Random] = None,
+) -> None:
+    """Worker serve loop that survives coordinator restarts (ISSUE 3).
+
+    Runs :func:`run_miner`; when the coordinator is declared lost (or a
+    dial fails), redials with jittered exponential backoff —
+    ``base_backoff · 2^k``, capped at ``max_backoff``, each wait scaled
+    by a uniform [0.5, 1.5) jitter so a whole fleet killed by one
+    coordinator crash does not redial in lockstep — and re-``Join``s.
+    The LSP boot epoch in the connect-ack guarantees the new session
+    shares no sequence state with the old one, and a restarted
+    coordinator re-ships every job template via the normal Setup path,
+    so resumption needs no worker-side state at all.
+
+    A session that actually served (the connection was established)
+    resets the backoff. ``max_dials`` bounds the loop for tests; the
+    production CLI runs it unbounded (cancel the task to stop).
+    """
+    delays = jittered_backoff(base_backoff, max_backoff, rng)
+    dials = 0
+    while True:
+        dials += 1
+        try:
+            await run_miner(
+                host, port, miner, params=params, on_result=on_result
+            )
+            # had a live session: fresh backoff episode
+            delays = jittered_backoff(base_backoff, max_backoff, rng)
+        except LspConnectError:
+            pass  # dial failed: coordinator still down, keep backing off
+        if max_dials is not None and dials >= max_dials:
+            return
+        wait = next(delays)
+        log.info(
+            "worker: coordinator gone; redialing in %.2fs (attempt %d)",
+            wait, dials + 1,
+        )
+        await asyncio.sleep(wait)
+
+
 def _safe_decode(raw: bytes) -> Optional[Message]:
     try:
         return decode_msg(raw)
@@ -475,6 +530,13 @@ def main(argv: Optional[list] = None) -> None:
         help="record a jax.profiler trace of the first mined chunk "
         "into DIR (viewable with tensorboard/xprof)",
     )
+    parser.add_argument(
+        "--reconnect", action="store_true",
+        help="survive coordinator restarts: when the coordinator is "
+        "declared lost, redial with jittered exponential backoff and "
+        "re-Join instead of exiting (pairs with the coordinator's "
+        "--journal crash recovery)",
+    )
     args = parser.parse_args(argv)
     host, _, port = args.hostport.rpartition(":")
     logging.basicConfig(level=logging.INFO)
@@ -521,7 +583,8 @@ def main(argv: Optional[list] = None) -> None:
                 f"import failed: {exc}"
             )
         miner = ProfiledMiner(miner, args.profile)
-    asyncio.run(run_miner(host or "127.0.0.1", int(port), miner))
+    role = run_miner_reconnect if args.reconnect else run_miner
+    asyncio.run(role(host or "127.0.0.1", int(port), miner))
 
 
 if __name__ == "__main__":
