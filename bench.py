@@ -523,6 +523,22 @@ def bench_cold_start(slab: int = SLAB) -> dict:
     return {"time_to_block_cold_cached_ms": round(cold["ms"], 1)}
 
 
+def _import_loadgen():
+    """scripts/ is not a package: put it on sys.path once (idempotent)
+    and return the loadgen module — the shared shim for every
+    control-plane/codec/recovery bench section."""
+    import os as _os
+    import sys as _sys
+
+    scripts = _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)), "scripts"
+    )
+    if scripts not in _sys.path:
+        _sys.path.insert(0, scripts)
+    import loadgen
+    return loadgen
+
+
 def bench_control_plane(fleets=(8, 64), duration: float = 5.0) -> dict:
     """Control-plane throughput/latency (scripts/loadgen.py): a REAL
     coordinator + N instant miners + M clients over the real LSP/UDP
@@ -532,14 +548,8 @@ def bench_control_plane(fleets=(8, 64), duration: float = 5.0) -> dict:
     figures are the headline (``control_plane_*`` fields); every fleet
     size lands under ``control_plane_fleet<N>_*``."""
     import asyncio
-    import os as _os
-    import sys as _sys
 
-    _sys.path.insert(
-        0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
-                         "scripts"),
-    )
-    import loadgen
+    loadgen = _import_loadgen()
 
     out = {}
     for fleet in fleets:
@@ -564,6 +574,56 @@ def bench_control_plane(fleets=(8, 64), duration: float = 5.0) -> dict:
     return out
 
 
+def bench_codec(fleet: int = 64, duration: float = 5.0,
+                pairs: int = 3) -> dict:
+    """Binary-codec + pipelining cost accounting (ISSUE 4 satellite):
+    the Round 7 profile's "~16% JSON codec" claim and the Round 9 gains
+    stay re-checkable from every shipped bench JSON.
+
+    Runs PAIRED alternating loadgen bursts — the full Round 9 stack
+    (binary codec, pipeline depth 2) against the PR 3 baseline stack
+    (JSON, depth 1) in the same build — and quotes the median of the
+    per-pair ratios, the only stable signal on a host whose absolute
+    throughput swings ~2x with ambient load (PERF.md §Round 8).
+    """
+    import asyncio
+    import statistics as _statistics
+
+    loadgen = _import_loadgen()
+
+    ratios = []
+    base = best = None
+    for _ in range(pairs):
+        b = asyncio.run(loadgen.run_load(
+            fleet, 4, duration, binary=False, pipeline_depth=1
+        ))
+        n = asyncio.run(loadgen.run_load(
+            fleet, 4, duration, binary=True, pipeline_depth=2
+        ))
+        ratios.append(n["results_per_s"] / max(b["results_per_s"], 1e-9))
+        if base is None or b["results_per_s"] > base["results_per_s"]:
+            base = b
+        if best is None or n["results_per_s"] > best["results_per_s"]:
+            best = n
+    return {
+        "codec_results_per_s_json_depth1": base["results_per_s"],
+        "codec_results_per_s_binary_depth2": best["results_per_s"],
+        "codec_speedup_pct_median": round(
+            100.0 * (_statistics.median(ratios) - 1.0), 1
+        ),
+        "codec_wire_bytes_per_result_json": base["wire_bytes_per_result"],
+        "codec_wire_bytes_per_result_binary": best["wire_bytes_per_result"],
+        # message-mix WITHIN the binary-stack run (the long-tail JSON
+        # residue vs the fast path) — unlike the *_json/*_binary pairs
+        # above, which compare the two runs
+        "codec_binary_run_msgs_json": best["msgs_json"],
+        "codec_binary_run_msgs_binary": best["msgs_binary"],
+        "codec_dispatches_pipelined": best["dispatches_pipelined"],
+        "codec_miner_idle_gap_p50_ms_json": base["miner_idle_gap_p50_ms"],
+        "codec_miner_idle_gap_p50_ms_binary": best["miner_idle_gap_p50_ms"],
+    }
+
+
 def bench_recovery(duration: float = 4.0, pairs: int = 3) -> dict:
     """Durability cost + crash-recovery latency (ISSUE 3), CPU-only
     like the control-plane section.
@@ -583,14 +643,9 @@ def bench_recovery(duration: float = 4.0, pairs: int = 3) -> dict:
     """
     import asyncio
     import os as _os
-    import sys as _sys
     import tempfile
 
-    _sys.path.insert(
-        0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
-                         "scripts"),
-    )
-    import loadgen
+    loadgen = _import_loadgen()
 
     base_best = journ_best = 0.0
     for _ in range(pairs):
@@ -680,6 +735,7 @@ def main() -> None:
         rate = bench_jnp(1 << 14)
         extra["scrypt_khs_per_chip"] = round(bench_scrypt(64, 2) / 1e3, 3)
         extra.update(bench_control_plane(fleets=(8,), duration=1.5))
+        extra.update(bench_codec(fleet=8, duration=1.5, pairs=1))
         extra.update(bench_recovery(duration=1.5, pairs=1))
         extra.update(bench_native(seconds=0.5))
     elif jax.default_backend() == "cpu":
@@ -692,6 +748,7 @@ def main() -> None:
         rate = bench_jnp(1 << 14)
         extra["scrypt_khs_per_chip"] = round(bench_scrypt(64, 2) / 1e3, 3)
         extra.update(bench_control_plane())
+        extra.update(bench_codec())
         extra.update(bench_recovery())
         extra.update(bench_native())
     else:
@@ -716,8 +773,10 @@ def main() -> None:
         extra.update(bench_pod_exact_min())
         extra.update(bench_cold_start())
         # CPU-side sections ride along on TPU captures too: the control
-        # plane, recovery, and native core are part of the headline
+        # plane, codec A/B, recovery, and native core are part of the
+        # headline
         extra.update(bench_control_plane())
+        extra.update(bench_codec())
         extra.update(bench_recovery())
         extra.update(bench_native())
     ghs = rate / 1e9

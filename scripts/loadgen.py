@@ -19,6 +19,19 @@ control plane) and M closed-loop clients, and reports:
   this bounds "no heartbeat deadline missed"
 - ``frames_sent``/``frames_received``/``acks_coalesced`` — datagram and
   ack-coalescing counters at the coordinator's transport seam
+- ``wire_bytes_per_result`` / ``msgs_json`` / ``msgs_binary`` — wire
+  volume per accepted result and the process-wide codec mix, so the
+  Round 7 "~16% JSON codec" profile claim stays re-checkable
+- ``dispatches_pipelined`` / ``pipeline_depth_mean`` / ``_max`` /
+  ``miner_idle_gap_p50_ms`` / ``_p99_ms`` — pipelining evidence: how
+  often a dispatch topped up a non-empty per-miner queue, the sampled
+  fill level, and the result→next-assign bubble at the miners (a full
+  round trip at depth 1; ~0 once the pipeline hides it)
+
+``--codec {binary,json}`` and ``--pipeline N`` are the Round 9 A/B
+knobs: ``--codec json --pipeline 1`` reproduces the PR 3 baseline
+stack in the same build, which is what makes paired per-stage
+measurement possible on this noisy host (PERF.md §Round 8 protocol).
 
 All miners/clients are in-process asyncio tasks (the same way the e2e
 suite fakes multi-node on localhost), so the figure is a whole-stack
@@ -78,27 +91,50 @@ from tpuminter.protocol import (  # noqa: E402
     Request,
     Result,
     Setup,
+    codec_stats,
     decode_msg,
     encode_msg,
+    payload_is_binary,
 )
 
 
-async def _instant_miner(port: int, params: Params) -> None:
+async def _instant_miner(
+    port: int, params: Params, *, binary: bool = True,
+    idle_gaps: Optional[list] = None,
+) -> None:
     """Join, then answer every Assign instantly with a *verifiable*
     Result (the real toy hash of the range's first nonce). The
     coordinator's per-result verification cost is therefore the
-    production cost; the miner's own cost is one host SHA-256."""
-    w = await LspClient.connect("127.0.0.1", port, params)
-    w.write(encode_msg(Join(backend="instant", lanes=1)))
-    templates = {}
+    production cost; the miner's own cost is one host SHA-256.
 
-    def handle(raw: bytes) -> None:
+    ``binary`` advertises the struct-packed codec (the worker role's
+    negotiation: Results flip to binary after the first binary payload
+    arrives from the coordinator). ``idle_gaps`` collects this miner's
+    result→next-assign gaps in seconds — the round-trip bubble the
+    pipelining tentpole exists to remove: at depth 1 every gap is a
+    full assign→result round trip; at depth ≥ 2 the next Assign is
+    already queued when the Result is written and the gap collapses."""
+    w = await LspClient.connect("127.0.0.1", port, params)
+    w.write(encode_msg(Join(
+        backend="instant", lanes=1, codec="bin" if binary else "json",
+    )))
+    templates = {}
+    speak = {"binary": False}
+    answered_at = {"t": None}  # time of the last Result write, gap-armed
+
+    def handle(raw) -> None:
+        if binary and not speak["binary"] and payload_is_binary(raw):
+            speak["binary"] = True
         msg = decode_msg(raw)
         if isinstance(msg, Setup):
             templates[msg.request.job_id] = msg.request
         elif isinstance(msg, Cancel):
             templates.pop(msg.job_id, None)
         elif isinstance(msg, Assign):
+            if answered_at["t"] is not None:
+                if idle_gaps is not None and len(idle_gaps) < 200_000:
+                    idle_gaps.append(time.monotonic() - answered_at["t"])
+                answered_at["t"] = None
             req = templates.get(msg.job_id)
             if req is None:
                 return
@@ -107,7 +143,8 @@ async def _instant_miner(port: int, params: Params) -> None:
                 hash_value=chain.toy_hash(req.data, msg.lower),
                 found=True, searched=msg.upper - msg.lower + 1,
                 chunk_id=msg.chunk_id,
-            )))
+            ), binary=speak["binary"]))
+            answered_at["t"] = time.monotonic()
 
     try:
         while True:
@@ -125,7 +162,8 @@ async def _instant_miner(port: int, params: Params) -> None:
 
 
 async def _resilient_instant_miner(port: int, params: Params,
-                                   seed: int) -> None:
+                                   seed: int, *,
+                                   binary: bool = True) -> None:
     """An instant miner that survives coordinator restarts: when the
     connection is lost it redials with jittered exponential backoff and
     re-Joins (the crash scenario's fleet)."""
@@ -135,7 +173,7 @@ async def _resilient_instant_miner(port: int, params: Params,
     delays = jittered_backoff(0.05, 1.0, rng)
     while True:
         try:
-            await _instant_miner(port, params)
+            await _instant_miner(port, params, binary=binary)
             delays = jittered_backoff(0.05, 1.0, rng)  # had a session
         except LspConnectError:
             pass
@@ -189,13 +227,19 @@ async def run_load(
     params: Params = FAST,
     warmup: float = 0.5,
     journal_path: Optional[str] = None,
+    binary: bool = True,
+    pipeline_depth: int = 2,
 ) -> dict:
     """Drive the fleet for ``duration`` seconds (after ``warmup``) and
     return the metrics dict described in the module docstring.
     ``journal_path`` enables write-ahead journaling — the knob behind
-    the ``recovery_journal_overhead_pct`` bench field."""
+    the ``recovery_journal_overhead_pct`` bench field. ``binary`` and
+    ``pipeline_depth`` are the Round 9 A/B knobs: ``binary=False,
+    pipeline_depth=1`` reproduces the PR 3 baseline stack, and the four
+    combinations give the per-stage decomposition PERF.md quotes."""
     coord = await Coordinator.create(
-        params=params, chunk_size=chunk_size, recover_from=journal_path
+        params=params, chunk_size=chunk_size, recover_from=journal_path,
+        binary_codec=binary, pipeline_depth=pipeline_depth,
     )
     serve = asyncio.ensure_future(coord.serve())
     # jobs long enough that every miner stays busy between completions
@@ -213,8 +257,11 @@ async def run_load(
 
     coord._server._handle_lost = counting_handle_lost
 
+    idle_gaps: list = []
     miners = [
-        asyncio.ensure_future(_instant_miner(coord.port, params))
+        asyncio.ensure_future(_instant_miner(
+            coord.port, params, binary=binary, idle_gaps=idle_gaps
+        ))
         for _ in range(n_miners)
     ]
     counter = {"jobs": 0}
@@ -226,6 +273,25 @@ async def run_load(
     ]
     stall = {"max_stall": 0.0}
     sampler = asyncio.ensure_future(_stall_sampler(0.001, stall))
+    # outstanding-depth samples across busy miners (the pipeline's
+    # live fill level; the gate reads dispatches_pipelined instead —
+    # a counter cannot miss between samples)
+    depth_samples: list = []
+
+    async def depth_sampler() -> None:
+        while True:
+            await asyncio.sleep(0.005)
+            if len(depth_samples) >= 100_000:
+                continue
+            busy = [
+                len(m.chunks) for m in coord._miners.values() if m.chunks
+            ]
+            if busy:
+                depth_samples.append(
+                    (sum(busy) / len(busy), max(busy))
+                )
+
+    depth_task = asyncio.ensure_future(depth_sampler())
     try:
         await asyncio.sleep(warmup)
         ep = coord.server.endpoint
@@ -237,10 +303,15 @@ async def run_load(
             coord.stats["results_accepted"] + coord.stats["results_rejected"]
         )
         rejected0 = coord.stats["results_rejected"]
+        pipelined0 = coord.stats["dispatches_pipelined"]
         lat_seen0 = len(coord.latencies)
         sent0, recv0 = ep.sent, ep.received
+        bytes0 = ep.sent_bytes + ep.received_bytes
+        codec0 = dict(codec_stats)
         jobs0 = counter["jobs"]
         stall["max_stall"] = 0.0  # warmup stalls (connect burst) excluded
+        depth_samples.clear()
+        idle_gaps.clear()
         await asyncio.sleep(duration)
         dt = time.monotonic() - t0
         assigns = coord._next_chunk_id - chunks0
@@ -251,10 +322,14 @@ async def run_load(
         lats = list(coord.latencies)[lat_seen0:] or [0.0]
         lats_ms = sorted(1e3 * x for x in lats)
         ack_stats = getattr(coord.server, "ack_stats", lambda: {})()
+        gaps_ms = sorted(1e3 * g for g in idle_gaps) or [0.0]
+        wire_bytes = ep.sent_bytes + ep.received_bytes - bytes0
         return {
             "fleet": n_miners,
             "clients": n_clients,
             "duration_s": round(dt, 3),
+            "codec": "binary" if binary else "json",
+            "pipeline_depth_configured": pipeline_depth,
             "results_per_s": round(results / dt, 1),
             "assigns_per_s": round(assigns / dt, 1),
             "jobs_per_s": round((counter["jobs"] - jobs0) / dt, 2),
@@ -269,9 +344,41 @@ async def run_load(
             "acks_coalesced": ack_stats.get("acks_coalesced", 0),
             "miners_lost": lost_events["n"],
             "results_rejected": coord.stats["results_rejected"] - rejected0,
+            # -- codec accounting (satellite: the 16%-JSON-codec claim
+            #    stays re-checkable from a shipped JSON). Message counts
+            #    are process-wide (both ends run in this process, so an
+            #    Assign counts once encoded and once decoded).
+            "wire_bytes_per_result": (
+                round(wire_bytes / results, 1) if results else 0.0
+            ),
+            "msgs_json": (
+                codec_stats["json_encoded"] + codec_stats["json_decoded"]
+                - codec0["json_encoded"] - codec0["json_decoded"]
+            ),
+            "msgs_binary": (
+                codec_stats["binary_encoded"] + codec_stats["binary_decoded"]
+                - codec0["binary_encoded"] - codec0["binary_decoded"]
+            ),
+            # -- pipelining evidence: dispatches that found work already
+            #    outstanding, the sampled fill level, and the
+            #    result→next-assign bubble at the miners
+            "dispatches_pipelined": (
+                coord.stats["dispatches_pipelined"] - pipelined0
+            ),
+            "pipeline_depth_mean": round(
+                statistics.mean(s[0] for s in depth_samples), 2
+            ) if depth_samples else 0.0,
+            "pipeline_depth_max": max(
+                (s[1] for s in depth_samples), default=0
+            ),
+            "miner_idle_gap_p50_ms": round(statistics.median(gaps_ms), 3),
+            "miner_idle_gap_p99_ms": round(
+                gaps_ms[max(0, int(len(gaps_ms) * 0.99) - 1)], 3
+            ),
         }
     finally:
         sampler.cancel()
+        depth_task.cancel()
         for t in clients + miners:
             t.cancel()
         await asyncio.gather(*clients, *miners, return_exceptions=True)
@@ -299,6 +406,20 @@ def smoke_check(metrics: dict, params: Params = FAST) -> list:
             f"event-loop stall {metrics['max_stall_ms']:.1f} ms >= one "
             f"{params.epoch_millis} ms epoch: heartbeat deadlines missed"
         )
+    # Round 9 gate: when the run is configured with the shipping
+    # defaults (pipelining depth >= 2, binary codec) the features must
+    # demonstrably be ON — a silent fallback to JSON or depth-1
+    # dispatch would pass the liveness checks while measuring nothing.
+    if (
+        metrics.get("pipeline_depth_configured", 1) >= 2
+        and metrics.get("dispatches_pipelined", 0) <= 0
+    ):
+        bad.append(
+            "pipelining configured but no dispatch ever topped up a "
+            "non-empty pipeline"
+        )
+    if metrics.get("codec") == "binary" and metrics.get("msgs_binary", 0) <= 0:
+        bad.append("binary codec configured but no binary messages flowed")
     return bad
 
 
@@ -381,6 +502,8 @@ async def run_crash(
     pre: float = 1.5,
     post: float = 3.0,
     drain: float = 10.0,
+    binary: bool = True,
+    pipeline_depth: int = 2,
 ) -> dict:
     """The crash-recovery drill: journaled coordinator + resilient
     fleet; kill the coordinator mid-burst (socket closed, no drain,
@@ -399,7 +522,8 @@ async def run_crash(
         tmpdir = tempfile.mkdtemp(prefix="tpuminter-loadgen-")
         journal_path = os.path.join(tmpdir, "coordinator.wal")
     coord = await Coordinator.create(
-        params=params, chunk_size=chunk_size, recover_from=journal_path
+        params=params, chunk_size=chunk_size, recover_from=journal_path,
+        binary_codec=binary, pipeline_depth=pipeline_depth,
     )
     port = coord.port
     serve = asyncio.ensure_future(coord.serve())
@@ -423,7 +547,9 @@ async def run_crash(
     upper = chunk_size * chunks_per_job - 1
     ledger = {"answers": {}, "submitted": 0, "stop": False}
     miners = [
-        asyncio.ensure_future(_resilient_instant_miner(port, params, i))
+        asyncio.ensure_future(
+            _resilient_instant_miner(port, params, i, binary=binary)
+        )
         for i in range(n_miners)
     ]
     clients = [
@@ -459,6 +585,7 @@ async def run_crash(
                 coord = await Coordinator.create(
                     port, params=params, chunk_size=chunk_size,
                     recover_from=journal_path,
+                    binary_codec=binary, pipeline_depth=pipeline_depth,
                 )
                 break
             except OSError:
@@ -585,13 +712,26 @@ def main(argv=None) -> int:
         help="journal file (steady: measures journaling overhead; "
         "crash: defaults to a temp file)",
     )
+    parser.add_argument(
+        "--codec", choices=("binary", "json"), default="binary",
+        help="app-message codec (binary = the struct-packed fast path "
+        "negotiated via Join; json = the PR 3 baseline for A/B runs)",
+    )
+    parser.add_argument(
+        "--pipeline", type=int, default=2, metavar="N",
+        help="chunks kept outstanding per miner (2 = shipping default; "
+        "1 = the PR 3 round-trip-per-chunk baseline for A/B runs)",
+    )
     parser.add_argument("--json", action="store_true", help="JSON output")
     args = parser.parse_args(argv)
+    knobs = dict(
+        binary=args.codec == "binary", pipeline_depth=args.pipeline,
+    )
     if args.scenario == "crash":
         metrics = asyncio.run(run_crash(
             args.miners, max(2, args.clients // 2),
             journal_path=args.journal, chunk_size=args.chunk_size,
-            pre=min(args.duration, 2.0), post=args.duration,
+            pre=min(args.duration, 2.0), post=args.duration, **knobs,
         ))
         print(json.dumps(metrics) if args.json else
               "\n".join(f"{k}: {v}" for k, v in metrics.items()))
@@ -604,7 +744,7 @@ def main(argv=None) -> int:
         args.duration = min(args.duration, 2.0)
     metrics = asyncio.run(run_load(
         args.miners, args.clients, args.duration,
-        chunk_size=args.chunk_size, journal_path=args.journal,
+        chunk_size=args.chunk_size, journal_path=args.journal, **knobs,
     ))
     print(json.dumps(metrics) if args.json else
           "\n".join(f"{k}: {v}" for k, v in metrics.items()))

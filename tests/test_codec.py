@@ -1,0 +1,272 @@
+"""Binary app-codec tests (ISSUE 4): golden vectors, cross-codec
+agreement, and the corruption contract — deterministic versions of the
+hypothesis properties in tests/test_properties.py, since this image
+lacks hypothesis (the wire layout must be pinned by tier-1 either way:
+a golden vector is the only thing that catches an accidental layout
+change, which round-trip properties are blind to)."""
+
+import random
+import struct
+import zlib
+
+import pytest
+
+from tpuminter.journal import decode_settle, encode_settle
+from tpuminter.protocol import (
+    MIN_UNTRACKED,
+    Assign,
+    Cancel,
+    Join,
+    PowMode,
+    ProtocolError,
+    Refuse,
+    Result,
+    Setup,
+    Request,
+    decode_msg,
+    encode_msg,
+    payload_is_binary,
+)
+
+# ---------------------------------------------------------------------------
+# golden vectors: the v1 layout, byte for byte. If any of these fail,
+# the wire format changed — that needs NEW tags, not edited vectors
+# (protocol module docstring: tags 0xB1-0xB5 ARE version 1).
+# ---------------------------------------------------------------------------
+
+
+def _crc(body: bytes) -> bytes:
+    return struct.pack("<I", zlib.crc32(body))
+
+
+GOLDEN = [
+    (
+        Assign(job_id=3, chunk_id=7, lower=0, upper=4095),
+        struct.pack("<BQQQQ", 0xB1, 3, 7, 0, 4095),
+    ),
+    (
+        Result(
+            job_id=3, mode=PowMode.TARGET, nonce=0xDEADBEEF,
+            hash_value=0x1234, found=True, searched=4096, chunk_id=7,
+        ),
+        struct.pack(
+            "<BBQQ32sBQQ", 0xB2, 1, 3, 0xDEADBEEF,
+            (0x1234).to_bytes(32, "little"), 1, 4096, 7,
+        ),
+    ),
+    (
+        Result(
+            job_id=1, mode=PowMode.MIN, nonce=2**64 - 1,
+            hash_value=MIN_UNTRACKED, found=False,
+        ),
+        struct.pack(
+            "<BBQQ32sBQQ", 0xB2, 0, 1, 2**64 - 1,
+            MIN_UNTRACKED.to_bytes(32, "little"), 0, 0, 0,
+        ),
+    ),
+    (
+        Refuse(job_id=3, chunk_id=7),
+        struct.pack("<BQQ", 0xB3, 3, 7),
+    ),
+    (
+        Cancel(job_id=9),
+        struct.pack("<BQ", 0xB4, 9),
+    ),
+    (
+        Join(backend="instant", lanes=4, span=1 << 30, codec="bin"),
+        struct.pack("<BBIQ16s", 0xB5, 1, 4, 1 << 30, b"instant"),
+    ),
+    (
+        Join(backend="cpu"),  # codec defaults to "json" → flags 0
+        struct.pack("<BBIQ16s", 0xB5, 0, 1, 0, b"cpu"),
+    ),
+]
+
+
+def test_golden_vectors_encode_exactly():
+    for msg, body in GOLDEN:
+        assert encode_msg(msg, binary=True) == body + _crc(body), msg
+
+
+def test_golden_vectors_decode_exactly():
+    for msg, body in GOLDEN:
+        assert decode_msg(body + _crc(body)) == msg
+        # and from a memoryview, the LSP layer's zero-copy delivery type
+        assert decode_msg(memoryview(body + _crc(body))) == msg
+
+
+def test_kind_lengths_are_distinct():
+    """Every binary kind has a unique total length, so a corrupted tag
+    can never alias another kind even before the CRC check (the
+    corruption property below leans on this)."""
+    lengths = {len(encode_msg(m, binary=True)) for m, _ in GOLDEN[:6]}
+    assert len(lengths) == 5  # assign, result, refuse, cancel, join
+
+
+# ---------------------------------------------------------------------------
+# cross-codec agreement: binary and JSON describe the SAME message
+# ---------------------------------------------------------------------------
+
+
+def _hot_messages():
+    rng = random.Random(0xC0DEC)
+    msgs = []
+    for _ in range(200):
+        kind = rng.randrange(5)
+        if kind == 0:
+            msgs.append(Assign(
+                rng.randrange(2**64), rng.randrange(2**64),
+                0, rng.randrange(2**64),
+            ))
+        elif kind == 1:
+            msgs.append(Result(
+                rng.randrange(2**64),
+                rng.choice([PowMode.MIN, PowMode.TARGET, PowMode.SCRYPT]),
+                rng.randrange(2**64), rng.randrange(2**256),
+                rng.random() < 0.5, searched=rng.randrange(2**64),
+                chunk_id=rng.randrange(2**64),
+            ))
+        elif kind == 2:
+            msgs.append(Refuse(rng.randrange(2**64), rng.randrange(2**64)))
+        elif kind == 3:
+            msgs.append(Cancel(rng.randrange(2**64)))
+        else:
+            msgs.append(Join(
+                backend=rng.choice(["cpu", "jax", "tpu", "pod", "native",
+                                    "instant", ""]),
+                lanes=rng.randrange(2**32), span=rng.randrange(2**64),
+                codec=rng.choice(["json", "bin"]),
+            ))
+    return msgs
+
+
+def test_binary_roundtrip_and_cross_codec_agreement():
+    """Every hot message round-trips binary↔dataclass, and a
+    binary-encoding peer and a JSON-encoding peer describe the same
+    message to a decoder (the mixed-fleet invariant: codec choice can
+    never change meaning)."""
+    for msg in _hot_messages():
+        b = encode_msg(msg, binary=True)
+        assert payload_is_binary(b), msg
+        assert decode_msg(b) == msg, msg
+        assert decode_msg(encode_msg(msg)) == msg, msg
+
+
+def test_binary_falls_back_to_json_when_unrepresentable():
+    for msg in [
+        Join(backend="x" * 20, codec="bin"),        # backend > 16 bytes
+        Join(backend="nul\x00", codec="bin"),       # NUL collides with pad
+        Cancel(job_id=2**64),                       # out of u64
+        Setup(Request(job_id=1, mode=PowMode.MIN, lower=0, upper=9)),
+    ]:
+        raw = encode_msg(msg, binary=True)
+        assert not payload_is_binary(raw)
+        assert decode_msg(raw) == msg
+
+
+# ---------------------------------------------------------------------------
+# corruption contract: corruption/truncation of a binary payload raises
+# ProtocolError — never a mis-parse, never a different exception
+# ---------------------------------------------------------------------------
+
+
+def test_every_single_byte_corruption_raises_protocol_error():
+    """EXHAUSTIVE over every byte × all 255 flips for every golden
+    vector (the CRC32 catches every burst ≤ 32 bits, so single-byte
+    flips are fully covered; a flip landing in the tag also trips the
+    per-kind length check)."""
+    for msg, body in GOLDEN:
+        wire = bytearray(body + _crc(body))
+        for i in range(len(wire)):
+            orig = wire[i]
+            for flip in range(1, 256):
+                wire[i] = orig ^ flip
+                with pytest.raises(ProtocolError):
+                    decode_msg(bytes(wire))
+            wire[i] = orig
+        assert decode_msg(bytes(wire)) == msg  # sanity: vector intact
+
+
+def test_every_truncation_raises_protocol_error():
+    for msg, body in GOLDEN:
+        wire = body + _crc(body)
+        for keep in range(len(wire)):
+            if keep == 0:
+                with pytest.raises(ProtocolError):
+                    decode_msg(b"")
+                continue
+            with pytest.raises(ProtocolError):
+                decode_msg(wire[:keep])
+
+
+def test_unknown_tags_raise():
+    for tag in range(256):
+        if tag in (0xB1, 0xB2, 0xB3, 0xB4, 0xB5, 0x7B):
+            continue
+        body = bytes([tag]) + b"\x00" * 16
+        with pytest.raises(ProtocolError):
+            decode_msg(body + _crc(body))
+
+
+# ---------------------------------------------------------------------------
+# packed journal settle record (tag 0xB7): same discipline on disk
+# ---------------------------------------------------------------------------
+
+
+def test_settle_record_roundtrips_to_replay_shape():
+    rng = random.Random(7)
+    for _ in range(100):
+        job_id = rng.randrange(2**64)
+        lo = rng.randrange(2**63)
+        hi = lo + rng.randrange(2**10)
+        nonce = rng.randrange(lo, hi + 1)
+        searched = hi - lo + 1
+        h = rng.randrange(2**256)
+        payload = encode_settle(job_id, lo, hi, nonce, searched, h)
+        rec = decode_settle(payload)
+        assert rec == {
+            "k": "settle", "id": job_id, "lo": lo, "hi": hi,
+            "n": nonce, "s": searched, "h": f"{h:x}",
+        }
+
+
+def test_settle_record_golden_vector():
+    payload = encode_settle(1, 0, 1023, 17, 1024, 0xABCD)
+    assert payload == struct.pack(
+        "<BQQQQQ32s", 0xB7, 1, 0, 1023, 17, 1024,
+        (0xABCD).to_bytes(32, "little"),
+    )
+    # any resize/retag reads as not-a-settle (→ scan treats the record
+    # as corruption, ending the readable prefix; never a mis-parse)
+    assert decode_settle(payload[:-1]) is None
+    assert decode_settle(b"\xb6" + payload[1:]) is None
+
+
+def test_settle_records_replay_like_json_settles():
+    """A journal whose settles are packed replays to the same state as
+    one whose settles are JSON — the formats are interchangeable on
+    disk (old journals keep replaying after the upgrade)."""
+    from tpuminter.journal import encode_record, frame_payload, replay, scan
+    from tpuminter.protocol import request_to_obj
+
+    req = Request(job_id=5, mode=PowMode.MIN, lower=0, upper=4095,
+                  data=b"x")
+    job = {"k": "job", "id": 1, "req": request_to_obj(req)}
+    settles = [(0, 1023, 7, 0x10), (1024, 2047, 1030, 0x20)]
+    blob_json = encode_record(job) + b"".join(
+        encode_record({
+            "k": "settle", "id": 1, "lo": lo, "hi": hi, "n": n,
+            "s": hi - lo + 1, "h": f"{h:x}",
+        })
+        for lo, hi, n, h in settles
+    )
+    blob_bin = encode_record(job) + b"".join(
+        frame_payload(encode_settle(1, lo, hi, n, hi - lo + 1, h))
+        for lo, hi, n, h in settles
+    )
+    recs_json, _ = scan(blob_json)
+    recs_bin, _ = scan(blob_bin)
+    assert recs_json == recs_bin
+    s1, s2 = replay(recs_json), replay(recs_bin)
+    assert s1.jobs[1].remaining == s2.jobs[1].remaining == [(2048, 4095)]
+    assert s1.jobs[1].best == s2.jobs[1].best == (0x10, 7)

@@ -437,7 +437,13 @@ def test_refused_assign_requeues_and_resends_setup():
                             templates.pop(msg.job_id, None)  # "evicted"
                             w.write(encode_msg(Refuse(msg.job_id, msg.chunk_id)))
                             continue
-                        t = templates[msg.job_id]
+                        t = templates.get(msg.job_id)
+                        if t is None:
+                            # a pipelined second Assign dispatched before
+                            # our Refuse landed: refuse it too, exactly
+                            # like the real worker role would
+                            w.write(encode_msg(Refuse(msg.job_id, msg.chunk_id)))
+                            continue
                         h, n = brute_min(t.data, msg.lower, msg.upper)
                         w.write(encode_msg(Result(
                             msg.job_id, t.mode, n, h, found=True,
@@ -1278,18 +1284,18 @@ def test_coordinator_soak_50_jobs_drains_all_bookkeeping():
             ))
             for _ in range(3000):
                 if any(
-                    m.backend == "mute" and m.chunk is not None
-                    and m.chunk[0] not in coord._audits
+                    m.backend == "mute" and cid not in coord._audits
                     for m in coord._miners.values()
+                    for cid in m.chunks
                 ):
                     break
                 await asyncio.sleep(0.01)
             else:
                 dump = {
-                    cid: (m.backend, m.chunk,
-                          m.chunk is not None
-                          and m.chunk[0] in coord._audits)
-                    for cid, m in coord._miners.items()
+                    conn: (m.backend, dict(m.chunks),
+                           sorted(c for c in m.chunks
+                                  if c in coord._audits))
+                    for conn, m in coord._miners.items()
                 }
                 raise AssertionError(
                     f"mute never stalled a job chunk; miners={dump} "
@@ -1363,7 +1369,7 @@ def test_coordinator_soak_50_jobs_drains_all_bookkeeping():
             assert not coord._audit_queue, coord._audit_queue
             assert coord._audits == {}, coord._audits
             for m in coord._miners.values():
-                assert m.chunk is None, (m.conn_id, m.chunk)
+                assert not m.chunks, (m.conn_id, dict(m.chunks))
             assert not any(coord._clients.values()), coord._clients
             snap = coord.stats_snapshot()
             assert snap["jobs_active"] == 0
@@ -1504,3 +1510,160 @@ def test_cancel_interrupts_pipelined_scrypt_within_one_span():
             await cluster.close()
 
     run(scenario(), timeout=120)
+
+
+# ---------------------------------------------------------------------------
+# binary-codec interop (ISSUE 4 acceptance): mixed-version peers share a
+# wire with no flag day — codec choice is negotiated per connection and
+# degrades to JSON whenever either side doesn't speak binary
+# ---------------------------------------------------------------------------
+
+def test_binary_coordinator_interops_with_json_only_worker():
+    """A binary-codec coordinator (shipping default) serving a worker
+    pinned to JSON (the pre-binary peer stand-in): no binary payload
+    may reach the worker, and the answer is still brute-force exact."""
+
+    async def scenario():
+        cluster = await Cluster.create(n_miners=0, chunk_size=1024)
+        task = asyncio.ensure_future(run_miner(
+            "127.0.0.1", cluster.coord.port, CpuMiner(), params=FAST,
+            binary=False,
+        ))
+        cluster.miner_tasks.append(task)
+        await asyncio.sleep(0.05)
+        try:
+            req = Request(job_id=4, mode=PowMode.MIN, lower=0, upper=6000,
+                          data=b"json-only worker")
+            result = await asyncio.wait_for(
+                submit("127.0.0.1", cluster.coord.port, req, params=FAST),
+                30.0,
+            )
+            assert (result.hash_value, result.nonce) == brute_min(
+                b"json-only worker", 0, 6000
+            )
+            # the negotiation really resolved to JSON for this conn
+            assert all(
+                not m.binary for m in cluster.coord._miners.values()
+            )
+        finally:
+            await cluster.close()
+
+    run(scenario())
+
+
+def test_json_coordinator_interops_with_binary_capable_worker():
+    """The other direction: an old (JSON-pinned) coordinator serving a
+    modern worker that ADVERTISES binary. The coordinator never sends a
+    binary payload, so the worker never flips its own send side — the
+    advertisement alone must not break anything."""
+
+    async def scenario():
+        cluster = await Cluster.create(
+            n_miners=1, chunk_size=1024, binary_codec=False
+        )
+        try:
+            req = Request(job_id=5, mode=PowMode.MIN, lower=0, upper=6000,
+                          data=b"json-only coordinator")
+            result = await asyncio.wait_for(
+                submit("127.0.0.1", cluster.coord.port, req, params=FAST),
+                30.0,
+            )
+            assert (result.hash_value, result.nonce) == brute_min(
+                b"json-only coordinator", 0, 6000
+            )
+            assert all(
+                not m.binary for m in cluster.coord._miners.values()
+            )
+        finally:
+            await cluster.close()
+
+    run(scenario())
+
+
+def test_binary_both_ends_negotiates_and_answers_exactly():
+    """Shipping defaults on both ends: the Join advertisement flips the
+    coordinator, the coordinator's first binary Assign flips the
+    worker, binary traffic actually flows, and the fold is still
+    brute-force exact (the codec can never change meaning)."""
+    from tpuminter import protocol
+
+    async def scenario():
+        before = dict(protocol.codec_stats)
+        cluster = await Cluster.create(n_miners=2, chunk_size=1024)
+        try:
+            req = Request(job_id=6, mode=PowMode.MIN, lower=0, upper=9000,
+                          data=b"binary both ends")
+            result = await asyncio.wait_for(
+                submit("127.0.0.1", cluster.coord.port, req, params=FAST),
+                30.0,
+            )
+            assert (result.hash_value, result.nonce) == brute_min(
+                b"binary both ends", 0, 9000
+            )
+            assert all(m.binary for m in cluster.coord._miners.values())
+            # both directions used the fast path: binary messages were
+            # encoded AND decoded in this process (assigns out, results
+            # back)
+            assert protocol.codec_stats["binary_encoded"] > before[
+                "binary_encoded"
+            ]
+            assert protocol.codec_stats["binary_decoded"] > before[
+                "binary_decoded"
+            ]
+        finally:
+            await cluster.close()
+
+    run(scenario())
+
+
+def test_hedge_loser_with_pipelined_chunks_releases_them_all():
+    """Pipelining × hedging regression: the hedge-loser Cancel is
+    job-scoped, so a loser holding OTHER chunks of the same job
+    (depth-2 pipeline) silently abandons them — the coordinator must
+    release and requeue every one of them at settlement, or the job
+    could only finish via a second hedge cycle (or never). Pinned by
+    the hedge count: exactly ONE hedge suffices, with the loser's
+    other chunk completing through a normal requeue."""
+    import time as _time
+
+    from tpuminter.worker import Miner
+
+    class StallMiner(Miner):
+        backend = "stall"
+        lanes = 1
+
+        def mine(self, request):
+            while True:
+                _time.sleep(0.05)
+                yield None
+
+    async def scenario():
+        cluster = await Cluster.create(
+            n_miners=0, chunk_size=3000, hedge_after=0.5
+        )
+        # join order pins breadth-first dispatch: stall takes chunks A
+        # and C (depth 2), cpu takes B
+        await cluster.add_miner(StallMiner())
+        await cluster.add_miner(CpuMiner(batch=256))
+        try:
+            req = Request(job_id=1, mode=PowMode.MIN, lower=0, upper=8999,
+                          data=b"hedge pipeline leak")
+            result = await asyncio.wait_for(
+                submit("127.0.0.1", cluster.coord.port, req, params=FAST),
+                30.0,
+            )
+            assert (result.hash_value, result.nonce) == brute_min(
+                b"hedge pipeline leak", 0, 8999
+            )
+            # one hedge rescued the stalled HEAD chunk; the loser's
+            # second pipelined chunk was requeued at settlement — a
+            # second hedge (the pre-fix self-heal path) means the
+            # release leaked
+            assert cluster.coord.stats["chunks_hedged"] == 1, (
+                cluster.coord.stats
+            )
+            assert cluster.coord.stats["chunks_requeued"] >= 1
+        finally:
+            await cluster.close()
+
+    run(scenario())

@@ -387,6 +387,82 @@ def test_protocol_roundtrip(msg):
 
 
 # ---------------------------------------------------------------------------
+# binary fast-path codec (ISSUE 4): hot messages round-trip through the
+# struct-packed encoding, binary and JSON peers agree on meaning, and
+# corruption/truncation of a binary payload is a ProtocolError — never a
+# mis-parse (tests/test_codec.py carries the deterministic golden-vector
+# and exhaustive-corruption versions, since this image lacks hypothesis)
+# ---------------------------------------------------------------------------
+
+from tpuminter.protocol import ProtocolError, payload_is_binary  # noqa: E402
+
+hot_messages = st.one_of(
+    st.builds(
+        Join,
+        backend=st.sampled_from(
+            ["cpu", "jax", "tpu", "pod", "native", "instant", ""]
+        ),
+        lanes=st.integers(1, 2**32 - 1),
+        span=st.integers(0, 2**64 - 1),
+        codec=st.sampled_from(["json", "bin"]),
+    ),
+    st.builds(
+        Result,
+        job_id=st.integers(0, 2**64 - 1),
+        mode=st.sampled_from([PowMode.MIN, PowMode.TARGET, PowMode.SCRYPT]),
+        nonce=st.integers(0, 2**64 - 1),
+        hash_value=st.integers(0, 2**256 - 1),
+        found=st.booleans(),
+        searched=st.integers(0, 2**64 - 1),
+        chunk_id=st.integers(0, 2**64 - 1),
+    ),
+    st.builds(
+        Assign,
+        job_id=st.integers(0, 2**64 - 1),
+        chunk_id=st.integers(0, 2**64 - 1),
+        lower=st.integers(0, 2**32 - 1),
+        upper=st.integers(0, 2**64 - 1),
+    ),
+    st.builds(
+        Refuse,
+        job_id=st.integers(0, 2**64 - 1),
+        chunk_id=st.integers(0, 2**64 - 1),
+    ),
+    st.builds(Cancel, job_id=st.integers(0, 2**64 - 1)),
+)
+
+
+@settings(max_examples=200)
+@given(hot_messages)
+def test_binary_codec_roundtrip_and_cross_codec_agreement(msg):
+    wire = encode_msg(msg, binary=True)
+    assert payload_is_binary(wire)
+    assert decode_msg(wire) == msg
+    assert decode_msg(memoryview(wire)) == msg  # the zero-copy path
+    # a JSON peer describing the same message decodes identically
+    assert decode_msg(encode_msg(msg)) == msg
+
+
+@settings(max_examples=200)
+@given(hot_messages, st.data())
+def test_binary_codec_corruption_raises_never_misparses(msg, data):
+    wire = bytearray(encode_msg(msg, binary=True))
+    i = data.draw(st.integers(0, len(wire) - 1))
+    wire[i] ^= data.draw(st.integers(1, 255))
+    with pytest.raises(ProtocolError):
+        decode_msg(bytes(wire))
+
+
+@settings(max_examples=200)
+@given(hot_messages, st.data())
+def test_binary_codec_truncation_raises_never_misparses(msg, data):
+    wire = encode_msg(msg, binary=True)
+    keep = data.draw(st.integers(0, len(wire) - 1))
+    with pytest.raises(ProtocolError):
+        decode_msg(wire[:keep])
+
+
+# ---------------------------------------------------------------------------
 # journal record stream (tpuminter.journal): the bundled-codec
 # corruption contract applied to disk, plus replay idempotency
 # ---------------------------------------------------------------------------

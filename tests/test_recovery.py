@@ -698,3 +698,112 @@ def test_loadgen_crash_scenario_smoke(capsys):
     # the journal actually carried state across the restart
     assert metrics["recovered_winners"] > 0
     assert metrics["journal"]["records"] > 0
+
+
+# ---------------------------------------------------------------------------
+# pipelined dispatch × crash recovery (ISSUE 4 satellite): kill -9 with
+# depth-2 queues in flight; replay re-mines exactly the un-settled ranges
+# ---------------------------------------------------------------------------
+
+def test_pipelined_crash_replay_remines_exactly_the_unsettled_ranges(
+    tmp_path,
+):
+    """A depth-2 miner holds TWO chunks when the coordinator dies, one
+    of them settled pre-crash. Replay must (a) show the pipeline really
+    was ≥2 deep, (b) rebuild remaining coverage as full-range minus the
+    settled chunk ONLY (in-flight pipeline chunks re-mine — they never
+    settled), and (c) a recovered coordinator + fresh miner then
+    re-mines exactly those nonces, no more, no fewer, with the final
+    fold brute-force exact across the crash."""
+    from tpuminter.protocol import (
+        Assign, Join, Result, Setup, decode_msg, encode_msg,
+    )
+
+    wal = str(tmp_path / "coordinator.wal")
+    data = b"pipelined crash"
+    upper = 4095
+    chunk = 1024
+
+    async def scenario():
+        coord = await Coordinator.create(
+            params=FAST, chunk_size=chunk, recover_from=wal
+        )
+        serve = asyncio.ensure_future(coord.serve())
+        w = await LspClient.connect("127.0.0.1", coord.port, FAST)
+        w.write(encode_msg(Join(backend="manual", lanes=1, codec="bin")))
+        client = await LspClient.connect("127.0.0.1", coord.port, FAST)
+        client.write(encode_msg(Request(
+            job_id=31, mode=PowMode.MIN, lower=0, upper=upper, data=data,
+            client_key="pipeline-ck",
+        )))
+        # the single miner must receive a Setup and TWO Assigns before
+        # answering anything — the depth-2 pipeline in flight
+        assigns = []
+        while len(assigns) < 2:
+            msg = decode_msg(await asyncio.wait_for(w.read(), 10))
+            if isinstance(msg, Assign):
+                assigns.append(msg)
+            else:
+                assert isinstance(msg, Setup)
+        a1, a2 = assigns
+        assert (a1.lower, a1.upper) == (0, chunk - 1)
+        assert (a2.lower, a2.upper) == (chunk, 2 * chunk - 1)
+        # settle ONLY the first chunk (a verifiable claim: the true
+        # minimum of its range)
+        h1, n1 = brute_min(data, a1.lower, a1.upper)
+        w.write(encode_msg(Result(
+            a1.job_id, PowMode.MIN, n1, h1, found=True,
+            searched=a1.upper - a1.lower + 1, chunk_id=a1.chunk_id,
+        ), binary=True))
+        # wait for the settle record to reach the OS (crash() drops the
+        # in-memory buffer; a flushed record survives kill -9)
+        deadline = time.monotonic() + 5
+        while coord._journal._buffer or coord._journal.stats["records"] < 3:
+            assert time.monotonic() < deadline, coord._journal.stats
+            await asyncio.sleep(0.01)
+        assert coord.stats["dispatches_pipelined"] >= 1
+        # -- kill -9 ----------------------------------------------------
+        serve.cancel()
+        await asyncio.gather(serve, return_exceptions=True)
+        coord.crash()
+        await coord.server.endpoint.wait_closed()
+        await w.close(drain_timeout=0.1)
+
+        # -- pure replay: coverage is full minus the settled chunk -----
+        with open(wal, "rb") as fh:
+            records, _ = scan(fh.read())
+        state = replay(records)
+        [job] = state.jobs.values()
+        assert merge_ranges(job.remaining) == [(chunk, upper)]
+        assert job.best == (h1, n1)
+        assert job.hashes_done == chunk
+
+        # -- recovered coordinator re-mines EXACTLY the rest -----------
+        coord2 = await Coordinator.create(
+            params=FAST, chunk_size=chunk, recover_from=wal
+        )
+        serve2 = asyncio.ensure_future(coord2.serve())
+        miner2 = asyncio.ensure_future(run_miner_reconnect(
+            "127.0.0.1", coord2.port, CpuMiner(), params=FAST, max_dials=1,
+        ))
+        try:
+            result = await asyncio.wait_for(submit(
+                "127.0.0.1", coord2.port,
+                Request(job_id=31, mode=PowMode.MIN, lower=0, upper=upper,
+                        data=data, client_key="pipeline-ck"),
+                params=FAST,
+            ), 30.0)
+            assert (result.hash_value, result.nonce) == brute_min(
+                data, 0, upper
+            )
+            assert result.searched == upper + 1  # pre-crash + re-mined
+            # the re-mine covered exactly the un-settled nonces
+            assert coord2.stats["hashes"] == upper + 1 - chunk
+        finally:
+            miner2.cancel()
+            serve2.cancel()
+            await asyncio.gather(miner2, serve2, return_exceptions=True)
+            await coord2.close()
+            await client.close(drain_timeout=0.1)
+
+    run(scenario(), timeout=60.0)

@@ -16,6 +16,12 @@ chosen for the heterogeneous-worker north-star, BASELINE.json:5):
   worker (lanes=1) gets small chunks, a TPU worker advertising millions
   of lanes gets pod-sized chunks — one policy serves both.
 - **Round-robin across jobs** so no client starves behind a big sweep.
+- **Per-miner dispatch pipelining** (``DEFAULT_PIPELINE_DEPTH``): every
+  miner keeps up to ``depth`` chunks outstanding, breadth-first filled,
+  so the assign→result round trip overlaps the next chunk's compute
+  instead of idling the miner at every boundary (PERF.md §Round 9).
+  Every settle/requeue/cancel/death path accounts for EVERY outstanding
+  chunk, not just one.
 - **Early exit propagates**: the first TARGET-mode hit finishes the job,
   replies to the client, drops its queued ranges, and ``Cancel``s the
   job's other in-flight chunks (≙ no reference analogue; see
@@ -41,6 +47,7 @@ from tpuminter.journal import (
     WINNERS_CAP,
     Journal,
     RecoveredState,
+    encode_settle,
     merge_ranges,
 )
 from tpuminter.lsp import LspServer, Params
@@ -77,6 +84,17 @@ DEFAULT_CHUNK_SIZE = 16_384
 #: single-span dispatch costs 9% of throughput at a 2^30 span vs 2% when
 #: several spans amortize the fill (PERF.md, pod striping section).
 SPANS_PER_DISPATCH = 4
+
+#: Chunks kept outstanding per miner (the per-miner dispatch pipeline,
+#: PERF.md §Round 9). At depth 1 every chunk boundary costs a full
+#: assign→result round trip of miner idle time — the fleet-64 profile's
+#: other named lever next to the JSON codec. At depth N the next chunk
+#: is already queued at the worker when a Result is written, so the
+#: round-trip bubble disappears; Result/Refuse/Cancel/lost-miner/crash
+#: paths settle or requeue EVERY outstanding chunk. Depth 2 is enough to
+#: hide one round trip (deeper queues only grow the requeue exposure on
+#: miner death); 1 restores the pre-pipelining behavior for A/B runs.
+DEFAULT_PIPELINE_DEPTH = 2
 
 
 #: unverifiable Results tolerated per miner before it is evicted — bounds
@@ -159,12 +177,21 @@ class _MinerState:
     #: worker's internal pipeline-stage size in nonces (Join.span);
     #: 0 = not pipelined (see SPANS_PER_DISPATCH)
     span: int = 0
-    #: (chunk_id, job_id, lower, upper) currently assigned, or None if
-    #: idle. The chunk_id lets a Result be matched to the exact dispatch
-    #: it answers: after a Cancel races a completion, a stale Result must
-    #: not clobber the miner's next assignment.
-    chunk: Optional[Tuple[int, int, int, int]] = None
-    chunk_at: float = 0.0  # monotonic dispatch time of `chunk`
+    #: outstanding-dispatch bound (DEFAULT_PIPELINE_DEPTH); 1 = the
+    #: pre-pipelining one-chunk-at-a-time behavior
+    depth: int = DEFAULT_PIPELINE_DEPTH
+    #: peer advertised the binary codec (Join.codec == "bin") AND the
+    #: coordinator has it enabled: Assign/Cancel to this miner go
+    #: struct-packed; Setup stays JSON (the ragged long tail)
+    binary: bool = False
+    #: outstanding dispatches, oldest first:
+    #: chunk_id → (job_id, lower, upper, dispatched_at). The chunk_id
+    #: lets a Result be matched to the exact dispatch it answers: after
+    #: a Cancel races a completion, a stale Result must not clobber any
+    #: of the miner's still-live assignments.
+    chunks: "OrderedDict[int, Tuple[int, int, int, float]]" = field(
+        default_factory=OrderedDict
+    )
     rejections: int = 0
     refusals: int = 0  # consecutive Refuses; reset on accepted Result
     #: per-worker observability (SURVEY.md §5): verified work only
@@ -172,6 +199,15 @@ class _MinerState:
     chunks_done: int = 0
     joined: float = field(default_factory=time.monotonic)
     last_result: Optional[float] = None
+
+    @property
+    def busy(self) -> bool:
+        return bool(self.chunks)
+
+    @property
+    def has_capacity(self) -> bool:
+        """True while the dispatch pipeline has room for another chunk."""
+        return len(self.chunks) < self.depth
 
     def snapshot(self) -> dict:
         """Rate/liveness view for :meth:`Coordinator.worker_stats`."""
@@ -185,7 +221,8 @@ class _MinerState:
             # raw, unrounded: a lifetime rate below 50 H/s must not
             # floor to 0.0 (callers/tests check mhs > 0; logs format it)
             "mhs": self.hashes / alive / 1e6 if alive > 0 else 0.0,
-            "busy": self.chunk is not None,
+            "busy": self.busy,
+            "outstanding": len(self.chunks),
             "idle_s": (
                 None if self.last_result is None
                 else round(now - self.last_result, 3)
@@ -221,7 +258,9 @@ class _Job:
     client_job_id: int           # echoed back in the final Result
     request: Request             # the client's original full-range request
     ranges: Deque[Tuple[int, int]] = field(default_factory=deque)
-    inflight: Dict[int, Tuple[int, int]] = field(default_factory=dict)  # miner conn → range
+    #: chunk_id → (miner conn, lower, upper). Keyed by chunk, not miner:
+    #: a pipelined miner holds several chunks of one job at once.
+    inflight: Dict[int, Tuple[int, int, int]] = field(default_factory=dict)
     best: Optional[Tuple[int, int]] = None  # (hash_value, nonce) min-fold
     #: miner conn_ids that hold this job's template (got its Setup)
     setup_sent: set = field(default_factory=set)
@@ -286,9 +325,20 @@ class Coordinator:
         stats_interval: float = 10.0,
         journal: Optional[Journal] = None,
         journal_assigns: bool = False,
+        pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
+        binary_codec: bool = True,
     ):
         self._server = server
         self._chunk_size = chunk_size
+        if pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1")
+        #: outstanding chunks per miner (DEFAULT_PIPELINE_DEPTH); 1
+        #: restores the pre-pipelining round-trip-per-chunk behavior
+        #: (the A/B baseline loadgen measures against)
+        self._pipeline_depth = pipeline_depth
+        #: speak the struct-packed codec to peers that advertise it;
+        #: False forces JSON everywhere (the codec A/B baseline)
+        self._binary_codec = binary_codec
         #: write-ahead journal (tpuminter.journal): every job/chunk/
         #: winner transition is appended (group-committed off the event
         #: loop); None = the seed's in-memory-only behavior
@@ -369,6 +419,10 @@ class Coordinator:
             "audits_failed": 0,
             "audits_inconclusive": 0,
             "verifications_offloaded": 0,
+            #: dispatches written to a miner that already had work
+            #: outstanding — the direct evidence that pipelining kept a
+            #: pipeline non-empty (loadgen's smoke gate reads it)
+            "dispatches_pipelined": 0,
         }
 
     @classmethod
@@ -385,6 +439,8 @@ class Coordinator:
         stats_interval: float = 10.0,
         recover_from: Optional[str] = None,
         journal_assigns: bool = False,
+        pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
+        binary_codec: bool = True,
     ) -> "Coordinator":
         """``recover_from`` names a write-ahead journal file
         (``tpuminter.journal``): if it exists its records are replayed —
@@ -406,7 +462,8 @@ class Coordinator:
             server, chunk_size=chunk_size, hedge_after=hedge_after,
             audit_rate=audit_rate, audit_seed=audit_seed,
             stats_interval=stats_interval, journal=journal,
-            journal_assigns=journal_assigns,
+            journal_assigns=journal_assigns, pipeline_depth=pipeline_depth,
+            binary_codec=binary_codec,
         )
         if recovered is not None:
             coord._adopt(recovered)
@@ -484,12 +541,26 @@ class Coordinator:
         if self._journal is None:
             return
         # the journal's highest-rate record (one per accepted chunk):
-        # hand-built JSON skips the dict + dumps round trip
-        self._journal.append_encoded(
-            b'{"id":%d,"lo":%d,"hi":%d,"h":"%x","n":%d,"s":%d,'
-            b'"k":"settle"}'
-            % (job.job_id, lo, hi, msg.hash_value, msg.nonce, searched)
-        )
+        # the same struct-packed discipline as the wire's binary Result
+        # (journal.encode_settle, tag 0xB7) — one struct.pack instead of
+        # the old hand-built JSON's six %-formats (the %x of a 256-bit
+        # int dominated). Request.__post_init__ bounds every range at
+        # 2^64-1 and the nonce is verified in-range, so the packed path
+        # always fits today — but a struct.error here would kill the
+        # serve loop, so EVERY u64 field is guarded (not just the one
+        # edge, searched == 2^64 on a maximal chunk) and anything
+        # unpackable takes the old JSON bytes.
+        if searched < (1 << 64) and hi < (1 << 64) and lo >= 0 \
+                and 0 <= msg.nonce < (1 << 64) and job.job_id < (1 << 64):
+            self._journal.append_encoded(encode_settle(
+                job.job_id, lo, hi, msg.nonce, searched, msg.hash_value
+            ))
+        else:
+            self._journal.append_encoded(
+                b'{"id":%d,"lo":%d,"hi":%d,"h":"%x","n":%d,"s":%d,'
+                b'"k":"settle"}'
+                % (job.job_id, lo, hi, msg.hash_value, msg.nonce, searched)
+            )
 
     def _journal_snapshot(self) -> dict:
         """Compacting checkpoint (``Journal.snapshot_provider``): the
@@ -502,7 +573,7 @@ class Coordinator:
                 continue
             remaining = merge_ranges(
                 list(job.ranges)
-                + list(job.inflight.values())
+                + [(lo, hi) for _conn, lo, hi in job.inflight.values()]
                 + list(job.verifying)
             )
             jobs.append({
@@ -642,7 +713,7 @@ class Coordinator:
             cur = self.stats["hashes"]
             if cur == last and not self._jobs:
                 continue
-            busy = sum(1 for m in self._miners.values() if m.chunk is not None)
+            busy = sum(1 for m in self._miners.values() if m.busy)
             log.info(
                 "rate: %.3f MH/s over the last %.0fs (total %d hashes, "
                 "%d jobs active, %d/%d workers busy)",
@@ -727,8 +798,9 @@ class Coordinator:
 
     def _mark_idle(self, miner: _MinerState) -> None:
         """Record a miner as dispatchable in the live idle set (only
-        miners still in the fleet with no assignment qualify)."""
-        if miner.chunk is None and miner.conn_id in self._miners:
+        miners still in the fleet with pipeline capacity qualify —
+        "idle" means "can take another chunk", not "doing nothing")."""
+        if miner.has_capacity and miner.conn_id in self._miners:
             self._idle[miner.conn_id] = miner
 
     def _drop_miner(self, conn_id: int) -> None:
@@ -742,44 +814,61 @@ class Coordinator:
         if conn_id in self._miners:
             return  # duplicate Join: already registered
         miner = _MinerState(
-            conn_id, msg.backend, max(1, msg.lanes), span=max(0, msg.span)
+            conn_id, msg.backend, max(1, msg.lanes), span=max(0, msg.span),
+            depth=self._pipeline_depth,
+            # codec negotiation (protocol module docstring): the worker
+            # advertised it decodes binary; our first binary Assign is
+            # what flips ITS send side in turn
+            binary=self._binary_codec and msg.codec == "bin",
         )
         self._miners[conn_id] = miner
         self._idle[conn_id] = miner
         log.info(
-            "miner %d joined (backend=%s, lanes=%d, span=%d)",
+            "miner %d joined (backend=%s, lanes=%d, span=%d, codec=%s)",
             conn_id, msg.backend, msg.lanes, msg.span,
+            "bin" if miner.binary else "json",
         )
         self._schedule_dispatch()
 
-    def _release_assignment(self, conn_id: int, miner: _MinerState) -> None:
-        """Requeue whatever a departing miner held — a job chunk back to
-        its job, an in-flight audit back to the audit queue. Marks the
-        miner idle again when it is staying in the fleet (the caller
-        drops it afterwards if not)."""
-        if miner.chunk is None:
-            return
-        chunk_id, job_id, lo, hi = miner.chunk
-        miner.chunk = None
-        self._mark_idle(miner)
+    def _release_chunk(
+        self, conn_id: int, chunk_id: int,
+        entry: Tuple[int, int, int, float],
+    ) -> None:
+        """Requeue ONE outstanding dispatch the miner no longer owns —
+        a job chunk back to its job, an in-flight audit back to the
+        audit queue. The caller has already removed it from
+        ``miner.chunks``."""
+        job_id, lo, hi, _at = entry
         audit = self._audits.pop(chunk_id, None)
         if audit is not None:
             self._audit_queue.append(audit)  # retry on another worker
             return
         job = self._jobs.get(job_id)
         if job is not None and not job.done:
-            job.inflight.pop(conn_id, None)
+            job.inflight.pop(chunk_id, None)
             self._requeue_chunk(job, lo, hi)
             log.info(
                 "released [%d, %d] of job %d from miner %d",
                 lo, hi, job_id, conn_id,
             )
 
+    def _release_assignment(self, conn_id: int, miner: _MinerState) -> None:
+        """Requeue EVERY chunk a departing miner held (a pipelined miner
+        holds up to ``depth`` at once — losing one must lose none of the
+        others from coverage). Marks the miner idle again when it is
+        staying in the fleet (the caller drops it afterwards if not)."""
+        if not miner.chunks:
+            return
+        chunks, miner.chunks = miner.chunks, OrderedDict()
+        for chunk_id, entry in chunks.items():
+            self._release_chunk(conn_id, chunk_id, entry)
+        self._mark_idle(miner)
+
     def _on_lost(self, conn_id: int) -> None:
         miner = self._miners.get(conn_id)
         if miner is not None:
             self._drop_miner(conn_id)
-            if miner.chunk is not None:
+            if miner.busy:
                 self._release_assignment(conn_id, miner)
                 log.info("miner %d died", conn_id)
             else:
@@ -886,17 +975,17 @@ class Coordinator:
         miner = self._miners.get(conn_id)
         if miner is None:
             return  # result from something that never Joined
-        if miner.chunk is None or miner.chunk[0] != msg.chunk_id:
-            # stale: answers a dispatch we already cancelled/requeued. The
-            # miner's current assignment (if any) is still being mined —
-            # leave it untouched, but give idle miners a chance at queued
-            # work before returning (ADVICE.md r1: returning early here
-            # could strand queued jobs until an unrelated event).
+        entry = miner.chunks.pop(msg.chunk_id, None)
+        if entry is None:
+            # stale: answers a dispatch we already cancelled/requeued.
+            # The miner's other outstanding assignments (if any) are
+            # still being mined — leave them untouched, but give idle
+            # miners a chance at queued work before returning (ADVICE.md
+            # r1: returning early here could strand queued jobs until an
+            # unrelated event).
             self._schedule_dispatch()
             return
-        _, job_id, lo, hi = miner.chunk
-        dispatched_at = miner.chunk_at
-        miner.chunk = None
+        job_id, lo, hi, dispatched_at = entry
         self._mark_idle(miner)
         audit = self._audits.pop(msg.chunk_id, None)
         if audit is not None:
@@ -905,7 +994,7 @@ class Coordinator:
             return
         job = self._jobs.get(job_id)
         if job is not None and not job.done:
-            job.inflight.pop(conn_id, None)
+            job.inflight.pop(msg.chunk_id, None)
             if job.request.mode == PowMode.SCRYPT:
                 # memory-hard verification (~hashlib.scrypt, ≥300 µs a
                 # call) must not run on the event loop: a fleet-wide
@@ -1087,20 +1176,26 @@ class Coordinator:
         miner = self._miners.get(conn_id)
         if miner is None:
             return
-        if miner.chunk is not None and miner.chunk[0] == msg.chunk_id:
-            job = self._jobs.get(miner.chunk[1])
+        entry = miner.chunks.pop(msg.chunk_id, None)
+        if entry is not None:
+            # only the refused dispatch is released: the miner's OTHER
+            # outstanding chunks (pipelining) are still being mined —
+            # and if the worker lost the whole template it will refuse
+            # each of them individually as they surface
+            job = self._jobs.get(entry[0])
             if job is not None:
                 job.setup_sent.discard(conn_id)
-            self._release_assignment(conn_id, miner)
+            self._release_chunk(conn_id, msg.chunk_id, entry)
+            self._mark_idle(miner)
             log.info(
                 "miner %d refused chunk %d (template will be re-sent)",
                 conn_id, msg.chunk_id,
             )
         miner.refusals += 1
         if miner.refusals >= MAX_REFUSALS:
-            # mirror _on_lost: a live assignment (possible when this
-            # Refuse was stale and the miner holds a different chunk)
-            # must be requeued, or its job would wait on it forever
+            # mirror _on_lost: live assignments (possible when this
+            # Refuse was stale and the miner holds other chunks) must
+            # be requeued, or their jobs would wait on them forever
             self._release_assignment(conn_id, miner)
             log.warning(
                 "miner %d evicted after %d consecutive refusals",
@@ -1142,14 +1237,19 @@ class Coordinator:
         the caller rolls back its own bookkeeping."""
         if miner.conn_id not in job.setup_sent:
             # LSP's ordered delivery guarantees the worker caches the
-            # Setup before any Assign referencing it arrives.
+            # Setup before any Assign referencing it arrives. Setup
+            # stays JSON (the ragged long-tail path) even to binary
+            # peers; only the per-chunk Assign takes the fast path.
             self._server.write(
                 miner.conn_id,
                 encode_msg(Setup(dc_replace(job.request, job_id=job.job_id))),
             )
             job.setup_sent.add(miner.conn_id)
         self._server.write(
-            miner.conn_id, encode_msg(Assign(job.job_id, chunk_id, lo, hi))
+            miner.conn_id,
+            encode_msg(
+                Assign(job.job_id, chunk_id, lo, hi), binary=miner.binary
+            ),
         )
 
     def _assign_audit(self, miner: _MinerState, job: _Job, audit: _Audit) -> bool:
@@ -1158,16 +1258,18 @@ class Coordinator:
         they are accounted by ``job.pending_audits`` instead."""
         chunk_id = self._next_chunk_id
         self._next_chunk_id += 1
-        miner.chunk = (chunk_id, job.job_id, audit.req.lower, audit.req.upper)
-        miner.chunk_at = time.monotonic()
-        self._idle.pop(miner.conn_id, None)
+        miner.chunks[chunk_id] = (
+            job.job_id, audit.req.lower, audit.req.upper, time.monotonic()
+        )
+        if not miner.has_capacity:
+            self._idle.pop(miner.conn_id, None)
         self._audits[chunk_id] = audit
         try:
             self._write_dispatch(
                 miner, job, chunk_id, audit.req.lower, audit.req.upper
             )
         except ConnectionError:
-            miner.chunk = None
+            miner.chunks.pop(chunk_id, None)
             self._audits.pop(chunk_id, None)
             return False
         return True
@@ -1273,9 +1375,9 @@ class Coordinator:
         """Return a chunk to the front of its job's queue (the shared
         path for miner death and rejected results)."""
         if any(
-            m.chunk is not None and m.chunk[1:] == (job.job_id, lo, hi)
-            and m.chunk[0] not in self._audits
+            entry[:3] == (job.job_id, lo, hi) and cid not in self._audits
             for m in self._miners.values()
+            for cid, entry in m.chunks.items()
         ):
             # a hedge backup is already mining this exact range: a
             # requeued third copy could be re-carved into sub-ranges the
@@ -1461,15 +1563,23 @@ class Coordinator:
         Result's chunk_id no longer matches and is ignored.
         """
         job.ranges.clear()
-        for miner_conn in list(job.inflight):
-            job.inflight.pop(miner_conn)
+        cancelled: set = set()
+        for chunk_id, (miner_conn, _lo, _hi) in list(job.inflight.items()):
+            job.inflight.pop(chunk_id, None)
             miner = self._miners.get(miner_conn)
-            if miner is not None and miner.chunk is not None \
-                    and miner.chunk[1] == job.job_id:
-                miner.chunk = None
+            if miner is not None and miner.chunks.pop(chunk_id, None) is not None:
                 self._mark_idle(miner)
+            if miner_conn in cancelled:
+                continue  # one Cancel covers every chunk of the job
+            cancelled.add(miner_conn)
             try:
-                self._server.write(miner_conn, encode_msg(Cancel(job.job_id)))
+                self._server.write(
+                    miner_conn,
+                    encode_msg(
+                        Cancel(job.job_id),
+                        binary=miner.binary if miner is not None else False,
+                    ),
+                )
             except ConnectionError:
                 pass
         self._schedule_dispatch()  # freed miners must not wait for an event
@@ -1524,6 +1634,8 @@ class Coordinator:
             if not self._assign_audit(auditor, job, audit):
                 held.append(audit)
                 failed.append(auditor)
+            elif auditor.has_capacity:
+                idle.append(auditor)  # pipeline not full: keep serving
         self._audit_queue.extendleft(reversed(held))
         while idle and self._rotation:
             job_id = self._rotation[0]
@@ -1541,6 +1653,11 @@ class Coordinator:
                 job.ranges.appendleft((lo, chunk_hi))
                 failed.append(miner)
                 continue
+            if miner.has_capacity:
+                # pipeline not full yet: back of the queue, so every
+                # miner reaches depth 1 before anyone reaches depth 2
+                # (breadth-first keeps the whole fleet busy first)
+                idle.append(miner)
             # rotate: next dispatch serves the next job
             self._rotation.rotate(-1)
         if self._hedge_after is not None and idle:
@@ -1584,17 +1701,20 @@ class Coordinator:
         failed (caller decides what to do with the range)."""
         chunk_id = self._next_chunk_id
         self._next_chunk_id += 1
-        miner.chunk = (chunk_id, job.job_id, lo, hi)
-        miner.chunk_at = time.monotonic()
-        self._idle.pop(miner.conn_id, None)
-        job.inflight[miner.conn_id] = (lo, hi)
+        pipelined = miner.busy
+        miner.chunks[chunk_id] = (job.job_id, lo, hi, time.monotonic())
+        if not miner.has_capacity:
+            self._idle.pop(miner.conn_id, None)
+        job.inflight[chunk_id] = (miner.conn_id, lo, hi)
         try:
             self._write_dispatch(miner, job, chunk_id, lo, hi)
         except ConnectionError:
             # lost between our bookkeeping and the write; undo
-            miner.chunk = None
-            job.inflight.pop(miner.conn_id, None)
+            miner.chunks.pop(chunk_id, None)
+            job.inflight.pop(chunk_id, None)
             return False
+        if pipelined:
+            self.stats["dispatches_pipelined"] += 1
         if self._journal_assigns:
             self._journal_append("assign", {
                 "id": job.job_id, "c": chunk_id, "lo": lo, "hi": hi,
@@ -1614,44 +1734,55 @@ class Coordinator:
         # ranges already dispatched to 2+ miners need no further hedging
         seen: Dict[Tuple[int, int, int], int] = {}
         for m in self._miners.values():
-            if m.chunk is not None and m.chunk[0] not in self._audits:
-                _, job_id, lo, hi = m.chunk
-                seen[(job_id, lo, hi)] = seen.get((job_id, lo, hi), 0) + 1
+            for cid, (job_id, lo, hi, _at) in m.chunks.items():
+                if cid not in self._audits:
+                    seen[(job_id, lo, hi)] = seen.get((job_id, lo, hi), 0) + 1
         candidates = sorted(
             (
-                m for m in self._miners.values()
-                if m.chunk is not None
-                and m.chunk[0] not in self._audits  # audits aren't hedged
-                and now - m.chunk_at > self._hedge_after
-                and seen[(m.chunk[1], m.chunk[2], m.chunk[3])] == 1
+                (at, m.conn_id, job_id, lo, hi)
+                for m in self._miners.values()
+                for cid, (job_id, lo, hi, at) in m.chunks.items()
+                if cid not in self._audits  # audits aren't hedged
+                and now - at > self._hedge_after
+                and seen[(job_id, lo, hi)] == 1
             ),
-            key=lambda m: m.chunk_at,
         )
-        for straggler in candidates:
+        for at, straggler_conn, job_id, lo, hi in candidates:
             if not idle:
                 return
-            _, job_id, lo, hi = straggler.chunk
             job = self._jobs.get(job_id)
             if job is None or job.done:
                 continue
             # the backup must be in the straggler's size class: handing a
             # device-carved chunk to a lanes=1 CPU would create a far
             # worse straggler. Pick the first idle miner whose own budget
-            # covers the chunk within a 4× stretch; skip otherwise.
+            # covers the chunk within a 4× stretch; skip otherwise. It
+            # must also be a DIFFERENT miner with an EMPTY pipeline:
+            # under pipelining a stalled miner still has queue capacity
+            # (it would otherwise get picked as its own backup), and a
+            # busy backup would just park the hedge behind its own
+            # head-of-line work instead of mining it now.
             size = hi - lo + 1
             backup = next(
-                (m for m in idle if 4 * self._budget(m, job) >= size), None
+                (
+                    m for m in idle
+                    if not m.busy and m.conn_id != straggler_conn
+                    and 4 * self._budget(m, job) >= size
+                ),
+                None,
             )
             if backup is None:
                 continue
             idle.remove(backup)
             if self._assign(backup, job, lo, hi):
+                if backup.has_capacity:
+                    idle.append(backup)
                 self.stats["chunks_hedged"] += 1
                 log.info(
                     "hedged straggler chunk [%d, %d] of job %d (miner %d, "
                     "%.1fs in flight) onto idle miner %d",
-                    lo, hi, job_id, straggler.conn_id,
-                    now - straggler.chunk_at, backup.conn_id,
+                    lo, hi, job_id, straggler_conn,
+                    now - at, backup.conn_id,
                 )
 
     def _settle_hedges(self, job: _Job, winner_conn: int,
@@ -1661,23 +1792,45 @@ class Coordinator:
         fails the chunk-id match and is dropped, so nothing double
         counts; the Cancel stops it burning device time."""
         for m in self._miners.values():
-            if (
-                m.conn_id != winner_conn
-                and m.chunk is not None
-                and m.chunk[0] not in self._audits  # never release audits
-                and m.chunk[1:] == (job.job_id, lo, hi)
-            ):
-                m.chunk = None
-                self._mark_idle(m)
-                job.inflight.pop(m.conn_id, None)
-                # the job is still live and this Cancel makes the loser
-                # evict its template — forget we Setup it so a later
-                # dispatch of THIS job to it re-ships the template
-                job.setup_sent.discard(m.conn_id)
-                try:
-                    self._server.write(m.conn_id, encode_msg(Cancel(job.job_id)))
-                except ConnectionError:
-                    pass
+            if m.conn_id == winner_conn:
+                continue
+            hedged = [
+                cid for cid, entry in m.chunks.items()
+                if cid not in self._audits
+                and entry[:3] == (job.job_id, lo, hi)
+            ]
+            if not hedged:
+                continue
+            for cid in hedged:
+                m.chunks.pop(cid, None)
+                job.inflight.pop(cid, None)
+            # The Cancel below is JOB-scoped: the loser abandons
+            # whatever chunk of this job it is currently mining
+            # (sending nothing back) and Refuses any queued Assigns
+            # against the popped template. Under pipelining the loser
+            # may hold OTHER chunks of the same job besides the hedged
+            # range — every one of them must be released NOW (ranges
+            # requeued, in-flight audits of this job back to the audit
+            # queue) or the job could never exhaust: its silently
+            # abandoned chunk would sit on the books forever. Only the
+            # hedged range itself is not requeued — the winner's
+            # verified Result already covers it.
+            for cid, entry in list(m.chunks.items()):
+                if entry[0] == job.job_id:
+                    m.chunks.pop(cid, None)
+                    self._release_chunk(m.conn_id, cid, entry)
+            self._mark_idle(m)
+            # the job is still live and this Cancel makes the loser
+            # evict its template — forget we Setup it so a later
+            # dispatch of THIS job to it re-ships the template
+            job.setup_sent.discard(m.conn_id)
+            try:
+                self._server.write(
+                    m.conn_id,
+                    encode_msg(Cancel(job.job_id), binary=m.binary),
+                )
+            except ConnectionError:
+                pass
 
 
 def main(argv: Optional[list] = None) -> None:
@@ -1712,6 +1865,19 @@ def main(argv: Optional[list] = None) -> None:
         help="period of the aggregate rate log line (default 10)",
     )
     parser.add_argument(
+        "--pipeline-depth", type=int, default=DEFAULT_PIPELINE_DEPTH,
+        metavar="N",
+        help="chunks kept outstanding per miner so a Result never "
+        "round-trips before the next chunk starts (default "
+        f"{DEFAULT_PIPELINE_DEPTH}; 1 = dispatch one chunk at a time)",
+    )
+    parser.add_argument(
+        "--codec", choices=("binary", "json"), default="binary",
+        help="app-message codec spoken to workers that advertise the "
+        "binary fast path (default binary; json forces the compat "
+        "path everywhere — decode always accepts both)",
+    )
+    parser.add_argument(
         "--journal", metavar="PATH", default=None,
         help="write-ahead job journal: every job/chunk/winner "
         "transition is appended (batched + fsynced off the event "
@@ -1730,6 +1896,8 @@ def main(argv: Optional[list] = None) -> None:
             audit_rate=args.audit_rate,
             stats_interval=args.stats_interval,
             recover_from=args.journal,
+            pipeline_depth=args.pipeline_depth,
+            binary_codec=args.codec == "binary",
         )
         log.info("coordinator listening on port %d", coord.port)
         if args.stats_port is not None:

@@ -8,7 +8,11 @@ jax_graft control plane cannot. This module is the persistence layer:
 **On-disk format** — an append-only file of length-prefixed,
 CRC-checksummed records (the LSP frame discipline applied to disk):
 ``size:u32 ‖ crc32:u32 ‖ payload[size]``, CRC over ``size ‖ payload``,
-payload = compact JSON. A record that fails to frame or checksum ends
+payload = compact JSON — except the highest-rate record, ``settle``,
+which is struct-packed (tag 0xB7, :func:`encode_settle`; the wire's
+binary-codec discipline applied to disk — PERF.md §Round 9; JSON
+settles from older journals still replay). A record that fails to
+frame or checksum ends
 the readable prefix — a torn tail and mid-file corruption are the same
 failure mode as a truncated file, exactly like the wire codec
 (tests/test_properties.py's bundled-codec properties): corruption can
@@ -77,6 +81,8 @@ __all__ = [
     "RecoveredJob",
     "RecoveredState",
     "encode_record",
+    "encode_settle",
+    "decode_settle",
     "scan",
     "replay",
     "merge_ranges",
@@ -130,6 +136,44 @@ def encode_record(obj: dict) -> bytes:
     return frame_payload(json.dumps(obj, separators=(",", ":")).encode())
 
 
+#: Packed settle record (PERF.md §Round 9): the journal's highest-rate
+#: append gets the wire codec's struct-packed treatment. The tag shares
+#: the '{'-disjoint namespace with ``tpuminter.protocol``'s binary
+#: message tags (0xB1–0xB5 there; 0xB7 here), so a record payload's
+#: first byte discriminates packed-settle from JSON exactly like an app
+#: payload. No inner CRC — the record framing already checksums every
+#: payload. JSON settle records from pre-Round-9 journals still replay
+#: through the ``{`` path, so old journals stay readable.
+_SETTLE_TAG = 0xB7
+_SETTLE = struct.Struct("<BQQQQQ32s")  # tag, id, lo, hi, n, s, h (u256 LE)
+
+
+def encode_settle(
+    job_id: int, lo: int, hi: int, nonce: int, searched: int,
+    hash_value: int,
+) -> bytes:
+    """Pack one settle payload (caller guarantees u64/u256 ranges —
+    the coordinator's values are verified-in-range by acceptance)."""
+    return _SETTLE.pack(
+        _SETTLE_TAG, job_id, lo, hi, nonce, searched,
+        hash_value.to_bytes(32, "little"),
+    )
+
+
+def decode_settle(payload: bytes) -> Optional[dict]:
+    """Unpack a packed settle payload into the replay-shaped record
+    dict, or None when ``payload`` is not one (wrong tag/size) — the
+    scanner then treats it as corruption, ending the readable prefix."""
+    if len(payload) != _SETTLE.size or payload[0] != _SETTLE_TAG:
+        return None
+    _, job_id, lo, hi, nonce, searched, digest = _SETTLE.unpack(payload)
+    return {
+        "k": "settle", "id": job_id, "lo": lo, "hi": hi,
+        "n": nonce, "s": searched,
+        "h": f"{int.from_bytes(digest, 'little'):x}",
+    }
+
+
 def scan(data: bytes) -> Tuple[List[dict], int]:
     """Decode the valid record prefix of ``data``.
 
@@ -150,6 +194,14 @@ def scan(data: bytes) -> Tuple[List[dict], int]:
         payload = bytes(data[off + _REC.size : end])
         if crc != zlib.crc32(payload, zlib.crc32(data[off : off + 4])):
             break
+        if payload[:1] != b"{":
+            # packed settle (the only non-JSON record kind)
+            obj = decode_settle(payload)
+            if obj is None:
+                break
+            records.append(obj)
+            off = end
+            continue
         try:
             obj = json.loads(payload)
         except ValueError:

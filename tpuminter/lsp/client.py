@@ -245,7 +245,10 @@ class LspClient:
         self._conn.write(payload)
 
     async def read(self) -> bytes:
-        """Next in-order payload from the server."""
+        """Next in-order payload from the server. Single-fragment
+        messages arrive as a zero-copy ``memoryview`` (compares equal
+        to bytes; ``protocol.decode_msg`` takes it directly — call
+        ``bytes()`` only if you need to hold or mutate it)."""
         item = await self._recv.get()
         if item is _LOST:
             self._recv.put_nowait(_LOST)  # subsequent reads keep failing

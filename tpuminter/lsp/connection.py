@@ -210,10 +210,14 @@ class ConnState:
             parts, self._rx_parts = self._rx_parts, []
             self._rx_bytes = 0
             # fragments are zero-copy memoryviews into their datagrams
-            # (message.decode); the single copy happens here, at
-            # app-message granularity
+            # (message.decode). A single-fragment message — every hot
+            # app message fits one frame — is delivered AS the view:
+            # the app codec (protocol.decode_msg) unpacks fields from
+            # it in place, so the hot path never copies the payload at
+            # all (the view keeps its datagram buffer alive). Only a
+            # multi-fragment message materializes, at the join.
             self._deliver(
-                bytes(parts[0]) if len(parts) == 1 else b"".join(parts)
+                parts[0] if len(parts) == 1 else b"".join(parts)
             )
 
     def _finish_close_if_drained(self) -> None:
@@ -246,6 +250,11 @@ class ConnState:
         delivery (fragmented across DATA frames as needed)."""
         if self.lost or self.closing:
             raise ConnectionError(f"conn {self.conn_id} is closed or lost")
+        if isinstance(payload, memoryview):
+            # echo/relay of a zero-copy delivered payload: materialize
+            # once here (bytes ops below need a bytes-like it can
+            # concatenate with)
+            payload = bytes(payload)
         for start in range(0, max(len(payload), 1), FRAGMENT_SIZE):
             part = payload[start : start + FRAGMENT_SIZE]
             flag = _MORE if start + FRAGMENT_SIZE < len(payload) else _FINAL

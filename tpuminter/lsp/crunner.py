@@ -34,12 +34,13 @@ async def run(host: str, port: int, messages, drop_pct: float = 0.0) -> None:
             for msg in messages:
                 client.write(msg.encode())
             for _ in messages:
-                print((await client.read()).decode(errors="replace"))
+                # read() may hand back a zero-copy memoryview
+                print(bytes(await client.read()).decode(errors="replace"))
             print(f"done: {len(messages)} replies, in order, loss-free")
         else:
             for i in itertools.count():
                 client.write(f"ping {i}".encode())
-                print((await client.read()).decode(errors="replace"))
+                print(bytes(await client.read()).decode(errors="replace"))
                 await asyncio.sleep(1.0)
     except LspConnectionLost:
         print("Disconnected")

@@ -222,7 +222,10 @@ class LspServer:
 
     async def read(self) -> Tuple[int, Optional[bytes]]:
         """Next event from any client: ``(conn_id, payload)``, where a
-        ``None`` payload means the connection was declared lost."""
+        ``None`` payload means the connection was declared lost.
+        Single-fragment payloads are zero-copy ``memoryview``s (they
+        compare equal to bytes and feed ``protocol.decode_msg``
+        directly)."""
         return await self._events.get()
 
     def read_nowait(self) -> Optional[Tuple[int, Optional[bytes]]]:

@@ -39,7 +39,7 @@ async def serve(port: int, drop_pct: float = 0.0, on_ready=None) -> None:
             if payload is None:
                 log.info("conn %d lost", conn_id)
                 continue
-            log.info("conn %d -> %r", conn_id, payload)
+            log.info("conn %d -> %r", conn_id, bytes(payload))
             try:
                 server.write(conn_id, payload)
             except ConnectionError:

@@ -47,6 +47,10 @@ class UdpEndpoint(asyncio.DatagramProtocol):
         #: Counters for tests/metrics.
         self.sent = 0
         self.received = 0
+        #: wire volume (post-fault datagram payload bytes): loadgen's
+        #: bytes-per-result metric reads these
+        self.sent_bytes = 0
+        self.received_bytes = 0
         self.dropped_out = 0
         self.dropped_in = 0
         self.duplicated_out = 0
@@ -97,6 +101,7 @@ class UdpEndpoint(asyncio.DatagramProtocol):
         if self._transport is None or self._transport.is_closing():
             return  # a held-back (reordered) datagram outlived the socket
         self.received += 1
+        self.received_bytes += len(data)
         result = self._on_datagram(data, addr)
         if asyncio.iscoroutine(result):
             asyncio.ensure_future(result)
@@ -155,12 +160,14 @@ class UdpEndpoint(asyncio.DatagramProtocol):
         sendto = self._transport.sendto
         for data in datagrams:
             self.sent += 1
+            self.sent_bytes += len(data)
             sendto(data, addr)
 
     def _send_now(self, data: bytes, addr: Addr) -> None:
         if self._transport is None or self._transport.is_closing():
             return  # a held-back (reordered) datagram outlived the socket
         self.sent += 1
+        self.sent_bytes += len(data)
         self._transport.sendto(data, addr)
 
     def set_write_drop_rate(self, rate: float) -> None:

@@ -24,11 +24,45 @@ minimum beats the target, which lets the coordinator early-exit the job
 and ``Cancel`` the other in-flight chunks — the control-plane half of the
 "whole pod stops on the first sub-target hash" story (BASELINE.json:5;
 the on-device half is the ICI or-reduce in ``tpuminter.mesh``).
+
+**Binary fast path (codec v1).** The fleet-64 profile put ~16% of the
+control-plane cost in this module's JSON round trip (PERF.md §Round 7),
+so the HOT messages — the ones that flow once per chunk or per
+connection: Assign, Result, Refuse, Cancel, Join — also have a
+struct-packed encoding behind the same :func:`encode_msg` /
+:func:`decode_msg` seam:
+
+``tag:u8 ‖ fields… ‖ crc32:u32`` (little-endian)
+
+The first byte discriminates the codec: JSON payloads always start with
+``{`` (0x7B), which is not a valid binary tag, so a decoder accepts both
+without negotiation. Tags 0xB1–0xB5 ARE version 1 of the binary codec —
+a future layout change allocates new tags rather than reinterpreting
+these. The trailing CRC32 (over everything before it) keeps the app
+codec under the same corruption contract as the LSP frames and the
+journal: a corrupted or truncated binary payload raises
+:class:`ProtocolError`, never mis-parses (every message kind also has a
+distinct total length, so even a corrupted tag cannot alias another
+kind). Request and Setup stay JSON-only — they are the long tail
+(rolled-job templates with ragged coinbase/branch fields, sent once per
+job or per (worker, job)) and the compat path.
+
+**No flag day.** Codec choice is per-connection and negotiated in band:
+a worker advertises capability in its (JSON-compatible) ``Join`` via
+``codec="bin"`` — an old coordinator ignores the unknown key and keeps
+speaking JSON — and a binary-capable coordinator answers such a worker
+with binary Assigns; the worker switches its own Results to binary only
+after it has SEEN a binary payload from the coordinator (proof the peer
+decodes them). Either side being older than the other therefore
+degrades to JSON automatically, which the interop e2e pins
+(tests/test_e2e.py).
 """
 
 from __future__ import annotations
 
 import json
+import struct
+import zlib
 from dataclasses import dataclass
 from enum import Enum
 from typing import Optional, Tuple, Union
@@ -45,10 +79,12 @@ __all__ = [
     "Message",
     "encode_msg",
     "decode_msg",
+    "payload_is_binary",
     "request_to_obj",
     "request_from_obj",
     "ProtocolError",
     "MIN_UNTRACKED",
+    "codec_stats",
 ]
 
 #: Sentinel ``hash_value`` in an exhausted TARGET Result from a worker
@@ -90,11 +126,19 @@ class Join:
     several in flight, so the coordinator sizes fast-dialect chunks to
     cover multiple spans — a single-span chunk drains the pipeline at
     every chunk boundary (measured 9% at a 2^30 span, PERF.md).
+
+    ``codec`` advertises the wire codecs this worker can DECODE:
+    ``"json"`` (the default — and all any pre-binary peer ever says) or
+    ``"bin"`` for the struct-packed fast path (module docstring). It is
+    an advertisement, not a demand: the coordinator still decodes both
+    from everyone, and only starts ENCODING binary toward a worker that
+    advertised it.
     """
 
     backend: str = "cpu"
     lanes: int = 1
     span: int = 0
+    codec: str = "json"
 
 
 @dataclass(frozen=True)
@@ -270,6 +314,164 @@ _KINDS = {
 }
 
 
+# ---------------------------------------------------------------------------
+# binary fast-path codec (v1; see module docstring)
+# ---------------------------------------------------------------------------
+
+#: First byte of every JSON payload; no binary tag may equal it.
+_JSON_OPEN = 0x7B  # ord("{")
+
+#: Codec v1 tags. A future layout revision allocates NEW tags; these
+#: five never change meaning.
+_TAG_ASSIGN = 0xB1
+_TAG_RESULT = 0xB2
+_TAG_REFUSE = 0xB3
+_TAG_CANCEL = 0xB4
+_TAG_JOIN = 0xB5
+# 0xB7 is reserved by tpuminter.journal for its packed settle record
+# (same '{'-disjoint tag space, so a journal payload can never be
+# confused with a wire message and vice versa).
+
+# Field layouts (little-endian). Every struct is a distinct total size
+# (+4 CRC bytes), so a corrupted tag always fails the length check even
+# before the CRC has its say — no kind can alias another.
+_BIN_ASSIGN = struct.Struct("<BQQQQ")        # tag, job, chunk, lo, hi
+_BIN_RESULT = struct.Struct("<BBQQ32sBQQ")   # tag, mode, job, nonce,
+#                                              hash (u256 LE), found,
+#                                              searched, chunk
+_BIN_REFUSE = struct.Struct("<BQQ")          # tag, job, chunk
+_BIN_CANCEL = struct.Struct("<BQ")           # tag, job
+_BIN_JOIN = struct.Struct("<BBIQ16s")        # tag, flags, lanes, span,
+#                                              backend (NUL-padded utf8)
+_CRC = struct.Struct("<I")
+
+_BIN_BY_TAG = {
+    _TAG_ASSIGN: _BIN_ASSIGN,
+    _TAG_RESULT: _BIN_RESULT,
+    _TAG_REFUSE: _BIN_REFUSE,
+    _TAG_CANCEL: _BIN_CANCEL,
+    _TAG_JOIN: _BIN_JOIN,
+}
+
+_JOIN_FLAG_BIN = 0x01  # Join.codec == "bin"
+
+_MODE_TO_WIRE = {PowMode.MIN: 0, PowMode.TARGET: 1, PowMode.SCRYPT: 2}
+_MODE_FROM_WIRE = {v: k for k, v in _MODE_TO_WIRE.items()}
+
+_U64 = 1 << 64
+_U256 = 1 << 256
+
+#: Process-wide codec traffic counters (observability for loadgen/bench:
+#: the json-vs-binary message mix is how the "16% JSON codec" profile
+#: claim stays re-checkable from a shipped JSON). Snapshot-and-diff;
+#: never reset in place.
+codec_stats = {
+    "json_encoded": 0,
+    "binary_encoded": 0,
+    "json_decoded": 0,
+    "binary_decoded": 0,
+}
+
+
+def payload_is_binary(raw) -> bool:
+    """True when an app payload uses the binary codec (first byte is a
+    tag, not JSON's ``{``). The worker's negotiation hook: seeing one
+    binary payload from the coordinator proves it decodes them."""
+    return len(raw) > 0 and raw[0] != _JSON_OPEN
+
+
+def _seal(body: bytes) -> bytes:
+    return body + _CRC.pack(zlib.crc32(body))
+
+
+def _encode_binary(msg: Message) -> Optional[bytes]:
+    """Pack one hot message, or None when it cannot be represented
+    (field out of the fixed-width range, non-hot kind) — the caller
+    falls back to JSON, which represents everything."""
+    if isinstance(msg, Assign):
+        if not (0 <= msg.job_id < _U64 and 0 <= msg.chunk_id < _U64
+                and 0 <= msg.lower < _U64 and 0 <= msg.upper < _U64):
+            return None
+        return _seal(_BIN_ASSIGN.pack(
+            _TAG_ASSIGN, msg.job_id, msg.chunk_id, msg.lower, msg.upper
+        ))
+    if isinstance(msg, Result):
+        if not (0 <= msg.job_id < _U64 and 0 <= msg.nonce < _U64
+                and 0 <= msg.hash_value < _U256
+                and 0 <= msg.searched < _U64 and 0 <= msg.chunk_id < _U64):
+            return None
+        return _seal(_BIN_RESULT.pack(
+            _TAG_RESULT, _MODE_TO_WIRE[msg.mode], msg.job_id, msg.nonce,
+            msg.hash_value.to_bytes(32, "little"), 1 if msg.found else 0,
+            msg.searched, msg.chunk_id,
+        ))
+    if isinstance(msg, Refuse):
+        if not (0 <= msg.job_id < _U64 and 0 <= msg.chunk_id < _U64):
+            return None
+        return _seal(_BIN_REFUSE.pack(_TAG_REFUSE, msg.job_id, msg.chunk_id))
+    if isinstance(msg, Cancel):
+        if not 0 <= msg.job_id < _U64:
+            return None
+        return _seal(_BIN_CANCEL.pack(_TAG_CANCEL, msg.job_id))
+    if isinstance(msg, Join):
+        backend = msg.backend.encode("utf-8", "strict")
+        if (len(backend) > 16 or b"\x00" in backend
+                or not 0 <= msg.lanes < (1 << 32)
+                or not 0 <= msg.span < _U64
+                or msg.codec not in ("json", "bin")):
+            return None
+        flags = _JOIN_FLAG_BIN if msg.codec == "bin" else 0
+        return _seal(_BIN_JOIN.pack(
+            _TAG_JOIN, flags, msg.lanes, msg.span, backend
+        ))
+    return None
+
+
+def _decode_binary(raw) -> Message:
+    n = len(raw)
+    tag = raw[0]
+    layout = _BIN_BY_TAG.get(tag)
+    if layout is None:
+        raise ProtocolError(f"unknown binary message tag {tag:#04x}")
+    if n != layout.size + _CRC.size:
+        raise ProtocolError(
+            f"binary payload for tag {tag:#04x} is {n} bytes, "
+            f"expected {layout.size + _CRC.size}"
+        )
+    view = memoryview(raw)
+    if zlib.crc32(view[: layout.size]) != _CRC.unpack_from(raw, layout.size)[0]:
+        raise ProtocolError("binary payload failed its checksum")
+    try:
+        if tag == _TAG_RESULT:
+            _, mode, job_id, nonce, digest, found, searched, chunk_id = (
+                _BIN_RESULT.unpack_from(raw)
+            )
+            if mode not in _MODE_FROM_WIRE or found not in (0, 1):
+                raise ProtocolError("malformed binary result fields")
+            return Result(
+                job_id, _MODE_FROM_WIRE[mode], nonce,
+                int.from_bytes(digest, "little"), bool(found),
+                searched=searched, chunk_id=chunk_id,
+            )
+        if tag == _TAG_ASSIGN:
+            _, job_id, chunk_id, lower, upper = _BIN_ASSIGN.unpack_from(raw)
+            return Assign(job_id, chunk_id, lower, upper)
+        if tag == _TAG_REFUSE:
+            _, job_id, chunk_id = _BIN_REFUSE.unpack_from(raw)
+            return Refuse(job_id, chunk_id)
+        if tag == _TAG_CANCEL:
+            (_, job_id) = _BIN_CANCEL.unpack_from(raw)
+            return Cancel(job_id)
+        _, flags, lanes, span, backend = _BIN_JOIN.unpack_from(raw)
+        return Join(
+            backend=backend.rstrip(b"\x00").decode("utf-8"),
+            lanes=lanes, span=span,
+            codec="bin" if flags & _JOIN_FLAG_BIN else "json",
+        )
+    except (struct.error, UnicodeDecodeError) as exc:
+        raise ProtocolError(f"malformed binary message: {exc}") from exc
+
+
 def _request_obj(msg: Request) -> dict:
     obj = {
         "kind": "request",
@@ -325,11 +527,25 @@ request_to_obj = _request_obj
 request_from_obj = _request_from_obj
 
 
-def encode_msg(msg: Message) -> bytes:
-    """Serialize an app message to a (JSON) LSP payload."""
+def encode_msg(msg: Message, *, binary: bool = False) -> bytes:
+    """Serialize an app message to an LSP payload.
+
+    ``binary=True`` uses the struct-packed fast path for the hot kinds
+    (Assign/Result/Refuse/Cancel/Join) when every field fits the fixed
+    widths, falling back to JSON otherwise — callers opt in per
+    connection after negotiation (module docstring), never blindly.
+    """
+    if binary:
+        raw = _encode_binary(msg)
+        if raw is not None:
+            codec_stats["binary_encoded"] += 1
+            return raw
+    codec_stats["json_encoded"] += 1
     if isinstance(msg, Join):
         obj = {"kind": "join", "backend": msg.backend, "lanes": msg.lanes,
                "span": msg.span}
+        if msg.codec != "json":
+            obj["codec"] = msg.codec
     elif isinstance(msg, Request):
         obj = _request_obj(msg)
     elif isinstance(msg, Setup):
@@ -362,10 +578,24 @@ def encode_msg(msg: Message) -> bytes:
     return json.dumps(obj, separators=(",", ":")).encode()
 
 
-def decode_msg(raw: bytes) -> Message:
-    """Parse an LSP payload back into an app message."""
+def decode_msg(raw) -> Message:
+    """Parse an LSP payload back into an app message.
+
+    Accepts ``bytes`` or the LSP layer's zero-copy ``memoryview``
+    directly: the binary fast path unpacks fields in place with no
+    payload copy at all, and only the JSON long tail materializes the
+    view (``json.loads`` does not take buffers)."""
+    if len(raw) == 0:
+        raise ProtocolError("empty payload")
+    if raw[0] != _JSON_OPEN:
+        msg = _decode_binary(raw)
+        codec_stats["binary_decoded"] += 1
+        return msg
+    codec_stats["json_decoded"] += 1
     try:
-        obj = json.loads(raw)
+        obj = json.loads(
+            raw if isinstance(raw, (bytes, bytearray, str)) else bytes(raw)
+        )
     except (ValueError, UnicodeDecodeError) as exc:
         raise ProtocolError(f"payload is not JSON: {exc}") from exc
     if not isinstance(obj, dict) or obj.get("kind") not in _KINDS:
@@ -377,6 +607,7 @@ def decode_msg(raw: bytes) -> Message:
                 backend=str(obj.get("backend", "cpu")),
                 lanes=int(obj.get("lanes", 1)),
                 span=int(obj.get("span", 0)),
+                codec=str(obj.get("codec", "json")),
             )
         if kind == "request":
             return _request_from_obj(obj)

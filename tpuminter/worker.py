@@ -47,6 +47,7 @@ from tpuminter.protocol import (
     Setup,
     decode_msg,
     encode_msg,
+    payload_is_binary,
 )
 
 __all__ = [
@@ -289,6 +290,7 @@ async def run_miner(
     *,
     params: Optional[Params] = None,
     on_result: Optional[Callable[[Result], None]] = None,
+    binary: bool = True,
 ) -> None:
     """Worker role main loop; returns when the coordinator is lost.
 
@@ -296,11 +298,29 @@ async def run_miner(
     handling layered in: while a chunk is being mined, an LSP read is kept
     in flight so a ``Cancel`` for the active job abandons it immediately;
     any other message read mid-mine is queued and handled after.
+
+    ``binary`` advertises the struct-packed codec in the Join
+    (``protocol`` module docstring): Results/Refuses switch to binary
+    only after the coordinator has SENT us a binary payload — proof it
+    decodes them — so an old coordinator gets JSON forever and nothing
+    needs a flag day. ``binary=False`` pins this worker to JSON (the
+    interop tests' "old peer" stand-in).
     """
     client = await LspClient.connect(host, port, params or FAST)
-    client.write(encode_msg(
-        Join(backend=miner.backend, lanes=miner.lanes, span=miner.span)
-    ))
+    client.write(encode_msg(Join(
+        backend=miner.backend, lanes=miner.lanes, span=miner.span,
+        codec="bin" if binary else "json",
+    )))
+    speak_binary = False
+
+    def note_codec(raw) -> None:
+        # negotiation hook: one binary payload from the coordinator
+        # flips our send side (never flips back — the peer's codec
+        # choice is per-incarnation)
+        nonlocal speak_binary
+        if binary and not speak_binary and payload_is_binary(raw):
+            speak_binary = True
+
     pending: "asyncio.Queue[Message]" = asyncio.Queue()
     read_task: Optional[asyncio.Task] = None
     #: job_id → template Request from a Setup (insertion-ordered so the
@@ -319,6 +339,7 @@ async def run_miner(
                     read_task = asyncio.ensure_future(client.read())
                 raw = await read_task
                 read_task = None
+                note_codec(raw)
                 msg = _safe_decode(raw)
                 if msg is None:
                     continue
@@ -341,7 +362,9 @@ async def run_miner(
                         "worker: no template for job %d; refusing chunk %d",
                         msg.job_id, msg.chunk_id,
                     )
-                    client.write(encode_msg(Refuse(msg.job_id, msg.chunk_id)))
+                    client.write(encode_msg(
+                        Refuse(msg.job_id, msg.chunk_id), binary=speak_binary
+                    ))
                     continue
                 msg = dc_replace(
                     tmpl, lower=msg.lower, upper=msg.upper, chunk_id=msg.chunk_id
@@ -372,9 +395,26 @@ async def run_miner(
                 if read_task.done():
                     raw = read_task.result()  # raises here if conn lost
                     read_task = None
+                    note_codec(raw)
                     inner = _safe_decode(raw)
                     if isinstance(inner, Cancel) and inner.job_id == msg.job_id:
                         cancelled = True
+                        # this branch consumes the Cancel, so the
+                        # top-level Cancel handler never sees it: evict
+                        # the template HERE too. Any Assign of the dead
+                        # job still queued behind this chunk (pipelined
+                        # dispatch) then takes the Refuse seam instead
+                        # of burning a whole chunk of device time on
+                        # retired work. Do NOT purge the pending queue
+                        # itself: a hedge-released job is still LIVE,
+                        # and its post-Cancel re-dispatch (Setup +
+                        # Assign, already queued by the time we process
+                        # this Cancel) must survive — the in-order
+                        # re-shipped Setup restores the template before
+                        # that Assign is handled, while silently
+                        # dropping it would wedge this worker
+                        # busy-forever on the coordinator's books.
+                        templates.pop(inner.job_id, None)
                         break
                     if inner is not None:
                         pending.put_nowait(inner)
@@ -383,7 +423,7 @@ async def run_miner(
                 continue
             if on_result is not None:
                 on_result(result)
-            client.write(encode_msg(result))
+            client.write(encode_msg(result, binary=speak_binary))
     except LspConnectionLost:
         log.info("worker: coordinator lost, exiting")
     finally:
@@ -406,6 +446,7 @@ async def run_miner_reconnect(
     max_backoff: float = 5.0,
     max_dials: Optional[int] = None,
     rng: Optional[random.Random] = None,
+    binary: bool = True,
 ) -> None:
     """Worker serve loop that survives coordinator restarts (ISSUE 3).
 
@@ -429,7 +470,8 @@ async def run_miner_reconnect(
         dials += 1
         try:
             await run_miner(
-                host, port, miner, params=params, on_result=on_result
+                host, port, miner, params=params, on_result=on_result,
+                binary=binary,
             )
             # had a live session: fresh backoff episode
             delays = jittered_backoff(base_backoff, max_backoff, rng)
@@ -531,6 +573,12 @@ def main(argv: Optional[list] = None) -> None:
         "into DIR (viewable with tensorboard/xprof)",
     )
     parser.add_argument(
+        "--codec", choices=("binary", "json"), default="binary",
+        help="wire codec advertised to the coordinator (binary = the "
+        "struct-packed fast path, negotiated — an old coordinator "
+        "still gets JSON; json pins this worker to the compat path)",
+    )
+    parser.add_argument(
         "--reconnect", action="store_true",
         help="survive coordinator restarts: when the coordinator is "
         "declared lost, redial with jittered exponential backoff and "
@@ -584,7 +632,10 @@ def main(argv: Optional[list] = None) -> None:
             )
         miner = ProfiledMiner(miner, args.profile)
     role = run_miner_reconnect if args.reconnect else run_miner
-    asyncio.run(role(host or "127.0.0.1", int(port), miner))
+    asyncio.run(role(
+        host or "127.0.0.1", int(port), miner,
+        binary=args.codec == "binary",
+    ))
 
 
 if __name__ == "__main__":
