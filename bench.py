@@ -681,6 +681,78 @@ def bench_recovery(duration: float = 4.0, pairs: int = 3) -> dict:
     }
 
 
+def bench_replication(duration: float = 4.0, pairs: int = 3) -> dict:
+    """Replication cost + fenced-failover latency (ISSUE 5), CPU-only
+    like the control-plane/recovery sections.
+
+    - ``replication_overhead_pct`` — results/s lost to WAL shipping +
+      live standby replay ON TOP of journaling, at fleet 8. Measured
+      with the paired-median protocol (alternating journaled-only /
+      journaled+standby runs, median of per-pair ratios) because this
+      host's absolute throughput swings ~2x with ambient load. Note
+      the standby shares the one core AND the event loop with the
+      primary here, so this is the worst-case colocated figure; a real
+      standby is another machine.
+    - ``replication_takeover_ms`` / ``_detect_ms`` / ``_blackout_ms``
+      — the failover drill (loadgen ``--scenario failover``): kill the
+      primary mid-burst (its journal is never re-read), promote the
+      standby with a fenced epoch, fleet lands by address rotation.
+    - ``replication_answers_lost`` / ``_duplicated`` — the
+      exactly-once ledger across the MACHINE loss; both must be 0.
+    """
+    import asyncio
+    import os as _os
+    import statistics as _statistics
+    import tempfile
+
+    loadgen = _import_loadgen()
+
+    ratios = []
+    journ_best = repl_best = 0.0
+    for _ in range(pairs):
+        tmp = tempfile.mktemp(suffix=".wal")
+        try:
+            j = asyncio.run(loadgen.run_load(
+                8, 4, duration, journal_path=tmp
+            ))["results_per_s"]
+        finally:
+            if _os.path.exists(tmp):
+                _os.unlink(tmp)
+        tmp = tempfile.mktemp(suffix=".wal")
+        try:
+            r = asyncio.run(loadgen.run_load(
+                8, 4, duration, journal_path=tmp, standby=True
+            ))["results_per_s"]
+        finally:
+            for suffix in ("", ".standby"):
+                if _os.path.exists(tmp + suffix):
+                    _os.unlink(tmp + suffix)
+        ratios.append(r / max(j, 1e-9))
+        journ_best = max(journ_best, j)
+        repl_best = max(repl_best, r)
+    drill = asyncio.run(loadgen.run_failover(
+        8, 2, pre=min(duration, 2.0), post=duration,
+    ))
+    return {
+        "replication_results_per_s_journaled": journ_best,
+        "replication_results_per_s_replicated": repl_best,
+        "replication_overhead_pct": round(
+            100.0 * (1.0 - _statistics.median(ratios)), 2
+        ),
+        "replication_detect_ms": drill.get("detect_ms"),
+        "replication_takeover_ms": drill.get("takeover_ms"),
+        "replication_blackout_ms": drill.get("blackout_ms"),
+        "replication_promote_ms": drill.get("promote_ms"),
+        "replication_dip_window_ms": drill.get("dip_window_ms"),
+        "replication_answers_lost": drill.get("answers_lost"),
+        "replication_answers_duplicated": drill.get("answers_duplicated"),
+        "replication_records_shipped_pre_kill": drill.get(
+            "replicated_records_pre_kill"
+        ),
+        "replication_recovered_winners": drill.get("recovered_winners"),
+    }
+
+
 def bench_native(seconds: float = 2.0) -> dict:
     """Measured native C++ double-SHA rate (README's backend table row;
     BASELINE.md quoted 1.84 MH/s on this host). Absent .so → empty."""
@@ -737,6 +809,7 @@ def main() -> None:
         extra.update(bench_control_plane(fleets=(8,), duration=1.5))
         extra.update(bench_codec(fleet=8, duration=1.5, pairs=1))
         extra.update(bench_recovery(duration=1.5, pairs=1))
+        extra.update(bench_replication(duration=1.5, pairs=1))
         extra.update(bench_native(seconds=0.5))
     elif jax.default_backend() == "cpu":
         # the TPU tunnel is down and jax silently fell back to CPU: say
@@ -750,6 +823,7 @@ def main() -> None:
         extra.update(bench_control_plane())
         extra.update(bench_codec())
         extra.update(bench_recovery())
+        extra.update(bench_replication())
         extra.update(bench_native())
     else:
         # persistent compilation cache, same as the worker CLI: the
@@ -778,6 +852,7 @@ def main() -> None:
         extra.update(bench_control_plane())
         extra.update(bench_codec())
         extra.update(bench_recovery())
+        extra.update(bench_replication())
         extra.update(bench_native())
     ghs = rate / 1e9
     print(

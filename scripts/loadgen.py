@@ -55,6 +55,18 @@ results/s recovers to half its pre-crash mean), ``answers_lost`` /
 ``answers_duplicated`` (the exactly-once ledger — both must be 0), and
 the journal's record/byte/flush counters. A small-fleet variant is the
 tier-1 crash gate (tests/test_recovery.py).
+
+``--scenario failover`` (ISSUE 5) drives the REPLICATED control plane:
+the primary ships its WAL to a live in-process hot standby
+(``tpuminter.replication``) and dies mid-burst — its journal file is
+never read again (machine loss, not process loss). The standby detects
+the silence, promotes with a fenced epoch (replay-free: its live
+shadow state becomes the coordinator), and the fleet — miners and
+durable clients configured with BOTH addresses — rotates onto it
+unattended. Reported: ``detect_ms`` / ``takeover_ms`` /
+``blackout_ms``, the exactly-once ledger across the machine loss, and
+shipping counters. ``--smoke`` is the tier-1 failover gate
+(tests/test_replication.py).
 """
 
 from __future__ import annotations
@@ -100,7 +112,8 @@ from tpuminter.protocol import (  # noqa: E402
 
 async def _instant_miner(
     port: int, params: Params, *, binary: bool = True,
-    idle_gaps: Optional[list] = None,
+    idle_gaps: Optional[list] = None, delay: float = 0.0,
+    connect_epochs: Optional[int] = None,
 ) -> None:
     """Join, then answer every Assign instantly with a *verifiable*
     Result (the real toy hash of the range's first nonce). The
@@ -113,14 +126,36 @@ async def _instant_miner(
     result→next-assign gaps in seconds — the round-trip bubble the
     pipelining tentpole exists to remove: at depth 1 every gap is a
     full assign→result round trip; at depth ≥ 2 the next Assign is
-    already queued when the Result is written and the gap collapses."""
-    w = await LspClient.connect("127.0.0.1", port, params)
+    already queued when the Result is written and the gap collapses.
+
+    ``delay`` sleeps that many seconds before answering each Assign —
+    the SlowMiner fleet for the pipeline-depth sweep: with per-chunk
+    compute time on the books, deeper queues can (or cannot) keep the
+    miner busy across coordinator scheduling latency, which is exactly
+    what the sweep measures. Chunks queue FIFO and answer one at a
+    time, like a real single-device worker."""
+    w = await LspClient.connect(
+        "127.0.0.1", port, params, connect_epochs=connect_epochs
+    )
     w.write(encode_msg(Join(
         backend="instant", lanes=1, codec="bin" if binary else "json",
     )))
     templates = {}
     speak = {"binary": False}
     answered_at = {"t": None}  # time of the last Result write, gap-armed
+    backlog: "asyncio.Queue" = asyncio.Queue()  # delay-mode work queue
+
+    def answer(msg: Assign) -> None:
+        req = templates.get(msg.job_id)
+        if req is None:
+            return
+        w.write(encode_msg(Result(
+            msg.job_id, req.mode, nonce=msg.lower,
+            hash_value=chain.toy_hash(req.data, msg.lower),
+            found=True, searched=msg.upper - msg.lower + 1,
+            chunk_id=msg.chunk_id,
+        ), binary=speak["binary"]))
+        answered_at["t"] = time.monotonic()
 
     def handle(raw) -> None:
         if binary and not speak["binary"] and payload_is_binary(raw):
@@ -135,17 +170,20 @@ async def _instant_miner(
                 if idle_gaps is not None and len(idle_gaps) < 200_000:
                     idle_gaps.append(time.monotonic() - answered_at["t"])
                 answered_at["t"] = None
-            req = templates.get(msg.job_id)
-            if req is None:
-                return
-            w.write(encode_msg(Result(
-                msg.job_id, req.mode, nonce=msg.lower,
-                hash_value=chain.toy_hash(req.data, msg.lower),
-                found=True, searched=msg.upper - msg.lower + 1,
-                chunk_id=msg.chunk_id,
-            ), binary=speak["binary"]))
-            answered_at["t"] = time.monotonic()
+            if delay > 0:
+                backlog.put_nowait(msg)
+            else:
+                answer(msg)
 
+    async def slow_answerer() -> None:
+        while True:
+            msg = await backlog.get()
+            await asyncio.sleep(delay)
+            answer(msg)
+
+    answerer = (
+        asyncio.ensure_future(slow_answerer()) if delay > 0 else None
+    )
     try:
         while True:
             raw = await w.read()
@@ -158,22 +196,39 @@ async def _instant_miner(
     except LspConnectionLost:
         pass  # CancelledError propagates: redial wrappers must see it
     finally:
+        if answerer is not None:
+            answerer.cancel()
+            await asyncio.gather(answerer, return_exceptions=True)
         await w.close(drain_timeout=0.2)
 
 
-async def _resilient_instant_miner(port: int, params: Params,
+async def _resilient_instant_miner(ports, params: Params,
                                    seed: int, *,
                                    binary: bool = True) -> None:
     """An instant miner that survives coordinator restarts: when the
     connection is lost it redials with jittered exponential backoff and
-    re-Joins (the crash scenario's fleet)."""
+    re-Joins (the crash scenario's fleet). ``ports`` may be one port or
+    a list — the failover scenario's address rotation: each failure
+    moves to the next port, so the fleet lands on a promoted standby
+    (an un-promoted one rejects the dial, which just advances the
+    rotation)."""
     import random as _random
+
+    if isinstance(ports, int):
+        ports = [ports]
+    from tpuminter.replication import dial_patience
 
     rng = _random.Random(seed)
     delays = jittered_backoff(0.05, 1.0, rng)
+    ce = dial_patience(ports)
+    attempt = 0
     while True:
+        port = ports[attempt % len(ports)]
+        attempt += 1
         try:
-            await _instant_miner(port, params, binary=binary)
+            await _instant_miner(
+                port, params, binary=binary, connect_epochs=ce
+            )
             delays = jittered_backoff(0.05, 1.0, rng)  # had a session
         except LspConnectError:
             pass
@@ -229,6 +284,11 @@ async def run_load(
     journal_path: Optional[str] = None,
     binary: bool = True,
     pipeline_depth: int = 2,
+    journal_tick_flush: bool = True,
+    standby: bool = False,
+    standby_sink: bool = False,
+    replica_ack: bool = False,
+    miner_delay: float = 0.0,
 ) -> dict:
     """Drive the fleet for ``duration`` seconds (after ``warmup``) and
     return the metrics dict described in the module docstring.
@@ -236,10 +296,36 @@ async def run_load(
     the ``recovery_journal_overhead_pct`` bench field. ``binary`` and
     ``pipeline_depth`` are the Round 9 A/B knobs: ``binary=False,
     pipeline_depth=1`` reproduces the PR 3 baseline stack, and the four
-    combinations give the per-stage decomposition PERF.md quotes."""
+    combinations give the per-stage decomposition PERF.md quotes.
+
+    Round 10 knobs: ``journal_tick_flush=False`` restores the PR 3/4
+    flusher task (the serve-tick fold's A/B baseline); ``standby=True``
+    attaches an in-process hot standby and ships the WAL to it (the
+    ``replication_*`` overhead measurement — requires a journal);
+    ``replica_ack`` additionally gates winner acks on standby
+    confirmation; ``miner_delay`` makes every miner take that many
+    seconds per chunk (the SlowMiner fleet for the pipeline-depth
+    sweep)."""
+    stby = None
+    replicate_to = None
+    if standby:
+        if journal_path is None:
+            raise ValueError("standby=True requires a journal_path")
+        from tpuminter.replication import ReplicationStandby
+
+        stby = await ReplicationStandby.create(
+            journal_path + ".standby", params=params,
+            # sink mode: persist+ack but no live shadow replay — the
+            # per-stage decomposition seam (PERF.md §Round 10)
+            apply_shadow=not standby_sink,
+        )
+        stby_task = asyncio.ensure_future(stby.run())
+        replicate_to = [("127.0.0.1", stby.port)]
     coord = await Coordinator.create(
         params=params, chunk_size=chunk_size, recover_from=journal_path,
         binary_codec=binary, pipeline_depth=pipeline_depth,
+        journal_tick_flush=journal_tick_flush,
+        replicate_to=replicate_to, replica_ack=replica_ack,
     )
     serve = asyncio.ensure_future(coord.serve())
     # jobs long enough that every miner stays busy between completions
@@ -260,7 +346,8 @@ async def run_load(
     idle_gaps: list = []
     miners = [
         asyncio.ensure_future(_instant_miner(
-            coord.port, params, binary=binary, idle_gaps=idle_gaps
+            coord.port, params, binary=binary, idle_gaps=idle_gaps,
+            delay=miner_delay,
         ))
         for _ in range(n_miners)
     ]
@@ -375,6 +462,20 @@ async def run_load(
             "miner_idle_gap_p99_ms": round(
                 gaps_ms[max(0, int(len(gaps_ms) * 0.99) - 1)], 3
             ),
+            **(
+                {
+                    "replication_batches": stby.stats["batches"],
+                    "replication_records_applied": (
+                        stby.stats["records_applied"]
+                    ),
+                    "replication_bytes": stby.stats["bytes"],
+                    "replication_lag_bytes": (
+                        (coord._journal.size if coord._journal else 0)
+                        - stby.size
+                    ),
+                }
+                if stby is not None else {}
+            ),
         }
     finally:
         sampler.cancel()
@@ -385,6 +486,10 @@ async def run_load(
         serve.cancel()
         await asyncio.gather(serve, return_exceptions=True)
         await coord.close()
+        if stby is not None:
+            stby_task.cancel()
+            await asyncio.gather(stby_task, return_exceptions=True)
+            await stby.close()
 
 
 def smoke_check(metrics: dict, params: Params = FAST) -> list:
@@ -428,29 +533,39 @@ def smoke_check(metrics: dict, params: Params = FAST) -> list:
 # ---------------------------------------------------------------------------
 
 async def _durable_client_loop(
-    port: int, params: Params, cid: int, upper: int, ledger: dict
+    ports, params: Params, cid: int, upper: int, ledger: dict
 ) -> None:
     """Closed-loop client that survives coordinator restarts: one LSP
     connection reused across jobs; on loss it redials with jittered
     backoff and RE-SUBMITS the in-flight request under its durable
     client_key and original job_id (the coordinator deduplicates).
     Every Result received is booked in ``ledger['answers']`` keyed by
-    (cid, job_id) — the exactly-once evidence the crash metrics read."""
+    (cid, job_id) — the exactly-once evidence the crash metrics read.
+    ``ports`` may be a list (failover address rotation, like the
+    resilient miners)."""
     import random as _random
 
+    from tpuminter.replication import dial_patience
+
+    if isinstance(ports, int):
+        ports = [ports]
     rng = _random.Random(1000 + cid)
     ckey = f"loadgen-{cid}"
     answers = ledger["answers"]
     jid = 0
+    attempt = 0
     pending: Optional[Request] = None
     client: Optional[LspClient] = None
     delays = jittered_backoff(0.05, 1.0, rng)
     try:
         while True:
             if client is None:
+                port = ports[attempt % len(ports)]
+                attempt += 1
                 try:
                     client = await LspClient.connect(
-                        "127.0.0.1", port, params
+                        "127.0.0.1", port, params,
+                        connect_epochs=dial_patience(ports),
                     )
                     delays = jittered_backoff(0.05, 1.0, rng)
                 except LspConnectError:
@@ -685,6 +800,232 @@ def crash_check(metrics: dict) -> list:
     return bad
 
 
+# ---------------------------------------------------------------------------
+# failover scenario (ISSUE 5): kill the primary machine, promote the standby
+# ---------------------------------------------------------------------------
+
+async def run_failover(
+    n_miners: int = 8,
+    n_clients: int = 2,
+    *,
+    chunk_size: int = 1024,
+    chunks_per_job: Optional[int] = None,
+    params: Params = FAST,
+    pre: float = 1.5,
+    post: float = 3.0,
+    drain: float = 10.0,
+    binary: bool = True,
+    pipeline_depth: int = 2,
+    replica_ack: bool = True,
+) -> dict:
+    """The replicated-coordinator drill: primary journals AND ships its
+    WAL to a live hot standby; mid-burst the primary machine "dies"
+    (socket closed with no drain, journal crashed, shipping lane cut —
+    and, unlike ``--scenario crash``, the primary's journal file is
+    NEVER read again: the takeover runs exclusively on what was
+    shipped). The standby detects the loss, promotes with a fenced
+    epoch, and the address-listed fleet (miners rotating their redial,
+    clients re-submitting under durable keys) lands on it unattended.
+
+    Reported: ``detect_ms`` (kill → standby declares the primary
+    lost), ``takeover_ms`` (promotion start → first chunk dispatched
+    by the new coordinator), ``blackout_ms`` (kill → first dispatch,
+    the end-to-end gap), ``dip_window_ms``, and the exactly-once
+    answer ledger — every submitted request answered exactly once
+    across the machine loss."""
+    import shutil
+
+    from tpuminter.replication import ReplicationStandby
+
+    tmpdir = tempfile.mkdtemp(prefix="tpuminter-failover-")
+    primary_wal = os.path.join(tmpdir, "primary.wal")
+    standby_wal = os.path.join(tmpdir, "standby.wal")
+    standby = await ReplicationStandby.create(standby_wal, params=params)
+    standby_task = asyncio.ensure_future(standby.run())
+    coord = await Coordinator.create(
+        params=params, chunk_size=chunk_size, recover_from=primary_wal,
+        binary_codec=binary, pipeline_depth=pipeline_depth,
+        replicate_to=[("127.0.0.1", standby.port)],
+        replica_ack=replica_ack,
+    )
+    ports = [coord.port, standby.port]
+    serve = asyncio.ensure_future(coord.serve())
+    state = {"coord": coord, "carried": 0}
+    t0 = time.monotonic()
+    buckets = []  # (t_rel, results_accepted delta) per 100 ms
+
+    async def sampler() -> None:
+        last = 0
+        while True:
+            await asyncio.sleep(0.1)
+            c = state["coord"]
+            cur = state["carried"] + (
+                c.stats["results_accepted"] if c is not None else 0
+            )
+            buckets.append((time.monotonic() - t0, cur - last))
+            last = cur
+
+    if chunks_per_job is None:
+        chunks_per_job = max(8, 2 * n_miners)
+    upper = chunk_size * chunks_per_job - 1
+    ledger = {"answers": {}, "submitted": 0, "stop": False}
+    miners = [
+        asyncio.ensure_future(
+            _resilient_instant_miner(ports, params, i, binary=binary)
+        )
+        for i in range(n_miners)
+    ]
+    clients = [
+        asyncio.ensure_future(
+            _durable_client_loop(ports, params, i, upper, ledger)
+        )
+        for i in range(n_clients)
+    ]
+    sample_task = asyncio.ensure_future(sampler())
+    metrics: dict = {
+        "fleet": n_miners, "clients": n_clients,
+        "chunk_size": chunk_size, "replica_ack": replica_ack,
+    }
+    coord2 = None
+    serve2 = None
+    try:
+        await asyncio.sleep(pre)
+        # shipping must have actually flowed pre-kill, or the drill
+        # would silently measure an empty takeover
+        metrics["replicated_records_pre_kill"] = (
+            standby.stats["records_applied"]
+        )
+        metrics["replication_lag_bytes_at_kill"] = (
+            coord._journal.size - standby.size
+        )
+        # -- the primary machine dies -----------------------------------
+        t_crash = time.monotonic()
+        metrics["t_crash_rel_s"] = round(t_crash - t0, 3)
+        state["carried"] += coord.stats["results_accepted"]
+        state["coord"] = None
+        serve.cancel()
+        await asyncio.gather(serve, return_exceptions=True)
+        coord.crash()
+        pre_results = state["carried"]
+        # -- the standby notices on its own (loss horizon) ---------------
+        await asyncio.wait_for(
+            standby.primary_lost.wait(),
+            10 * params.epoch_limit * params.epoch_seconds,
+        )
+        t_detect = time.monotonic()
+        metrics["detect_ms"] = round((t_detect - t_crash) * 1e3, 1)
+        # -- fenced promotion: replay-free takeover ----------------------
+        coord2 = await standby.promote(
+            chunk_size=chunk_size, binary_codec=binary,
+            pipeline_depth=pipeline_depth,
+        )
+        metrics["promote_ms"] = round(
+            (time.monotonic() - t_detect) * 1e3, 3
+        )
+        metrics["promoted_epoch"] = coord2.boot_epoch
+        metrics["recovered_jobs"] = len(coord2._jobs)
+        metrics["recovered_winners"] = len(coord2._winners)
+        serve2 = asyncio.ensure_future(coord2.serve())
+        state["coord"] = coord2
+        # takeover = promotion start → first chunk dispatched by the
+        # new coordinator (includes the fleet's rotation + re-Joins)
+        while coord2._next_chunk_id == 1:
+            if time.monotonic() - t_detect > max(post, 10.0):
+                break
+            await asyncio.sleep(0.001)
+        t_first = time.monotonic()
+        metrics["takeover_ms"] = round((t_first - t_detect) * 1e3, 1)
+        metrics["blackout_ms"] = round((t_first - t_crash) * 1e3, 1)
+        await asyncio.sleep(post)
+        # -- drain: no new jobs; in-flight ones get `drain` s to answer --
+        ledger["stop"] = True
+        done, pending_tasks = await asyncio.wait(clients, timeout=drain)
+        for t in pending_tasks:
+            t.cancel()
+        await asyncio.gather(*clients, return_exceptions=True)
+        # -- exactly-once ledger ----------------------------------------
+        answers = ledger["answers"]
+        metrics["submitted"] = ledger["submitted"]
+        metrics["answered"] = sum(1 for c in answers.values() if c >= 1)
+        metrics["answers_duplicated"] = sum(
+            c - 1 for c in answers.values() if c > 1
+        )
+        metrics["answers_lost"] = ledger["submitted"] - metrics["answered"]
+        metrics["results_accepted_pre_crash"] = pre_results
+        metrics["results_accepted_total"] = state["carried"] + (
+            coord2.stats["results_accepted"]
+        )
+        metrics["fenced_rejections"] = coord2.stats["replication_fenced"]
+        # -- dip window: crash → results/s back to half its pre rate ----
+        tc = t_crash - t0
+        pre_rates = [d for (t, d) in buckets if tc - 1.0 <= t < tc]
+        pre_mean = (sum(pre_rates) / len(pre_rates)) if pre_rates else 0.0
+        dip_end = next(
+            # t > tc + 0.15: the 100 ms bucket straddling the kill still
+            # holds pre-crash results and must not read as "recovered"
+            (t for (t, d) in buckets
+             if t > tc + 0.15 and pre_mean > 0 and d >= 0.5 * pre_mean),
+            None,
+        )
+        metrics["dip_window_ms"] = (
+            round((dip_end - tc) * 1e3, 1) if dip_end is not None
+            else round(post * 1e3, 1)
+        )
+        if coord2._journal is not None:
+            metrics["journal"] = dict(coord2._journal.stats)
+        return metrics
+    finally:
+        sample_task.cancel()
+        standby_task.cancel()
+        for t in clients + miners:
+            t.cancel()
+        await asyncio.gather(
+            sample_task, standby_task, *clients, *miners,
+            return_exceptions=True,
+        )
+        if serve2 is not None:
+            serve2.cancel()
+            await asyncio.gather(serve2, return_exceptions=True)
+        if coord2 is not None:
+            await coord2.close()
+        elif not standby.promoted:
+            await standby.close()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def failover_check(metrics: dict, params: Params = FAST) -> list:
+    """The failover drill's pass/fail assertions (the tier-1 gate
+    shape): shipping actually flowed, the fleet landed on the promoted
+    standby unattended, takeover stayed under one loss horizon, and
+    the answer ledger is exactly-once across the machine loss."""
+    horizon_ms = params.epoch_limit * params.epoch_millis
+    bad = []
+    if metrics.get("replicated_records_pre_kill", 0) <= 0:
+        bad.append(
+            "no records were replicated before the kill: the drill "
+            "measured an empty takeover"
+        )
+    if metrics.get("answered", 0) <= 0:
+        bad.append(f"no requests answered at all: {metrics}")
+    if metrics.get("answers_duplicated", 0) > 0:
+        bad.append(
+            f"{metrics['answers_duplicated']} duplicate answer(s): a "
+            f"client saw the same request id answered twice"
+        )
+    if metrics.get("answers_lost", 0) > 0:
+        bad.append(
+            f"{metrics['answers_lost']} request(s) never answered "
+            f"despite the drain window"
+        )
+    if metrics.get("takeover_ms", 1e9) > horizon_ms:
+        bad.append(
+            f"takeover took {metrics.get('takeover_ms')} ms, over one "
+            f"loss horizon ({horizon_ms} ms): the promoted standby did "
+            f"not pick the fleet up promptly"
+        )
+    return bad
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="tpuminter control-plane load generator"
@@ -701,16 +1042,44 @@ def main(argv=None) -> int:
         "or a fleet that fails to resume)",
     )
     parser.add_argument(
-        "--scenario", choices=("steady", "crash"), default="steady",
+        "--scenario", choices=("steady", "crash", "failover"),
+        default="steady",
         help="steady: the sustained-burst benchmark; crash: kill the "
         "journaled coordinator mid-burst, restart it from the journal "
         "on the same port, and report recovery latency plus the "
-        "exactly-once answer ledger",
+        "exactly-once answer ledger; failover: primary ships its WAL "
+        "to a live hot standby, dies mid-burst WITHOUT its journal "
+        "ever being re-read, the standby promotes with a fenced epoch "
+        "and the address-listed fleet lands on it — reports "
+        "detect/takeover/blackout latency plus the same ledger",
     )
     parser.add_argument(
         "--journal", metavar="PATH", default=None,
         help="journal file (steady: measures journaling overhead; "
         "crash: defaults to a temp file)",
+    )
+    parser.add_argument(
+        "--journal-flush", choices=("tick", "task"), default="tick",
+        help="journal flush scheduling: 'tick' folds the flusher into "
+        "the serve loop's burst cadence (Round 10 default), 'task' "
+        "restores the PR 3/4 batch-window flusher task for A/B runs",
+    )
+    parser.add_argument(
+        "--standby", action="store_true",
+        help="steady scenario: attach an in-process hot standby and "
+        "ship the journal to it (measures replication overhead; "
+        "requires --journal)",
+    )
+    parser.add_argument(
+        "--replica-ack", action="store_true",
+        help="with --standby (or in the failover drill): gate winner "
+        "acknowledgements on standby confirmation",
+    )
+    parser.add_argument(
+        "--miner-delay", type=float, default=0.0, metavar="SECONDS",
+        help="every miner takes this long per chunk (a SlowMiner "
+        "fleet — the pipeline-depth sweep's workload; default 0 = "
+        "instant)",
     )
     parser.add_argument(
         "--codec", choices=("binary", "json"), default="binary",
@@ -727,6 +1096,22 @@ def main(argv=None) -> int:
     knobs = dict(
         binary=args.codec == "binary", pipeline_depth=args.pipeline,
     )
+    if args.scenario == "failover":
+        if args.smoke:
+            args.miners = min(args.miners, 8)
+            args.duration = min(args.duration, 2.0)
+        metrics = asyncio.run(run_failover(
+            args.miners, max(2, args.clients // 2),
+            chunk_size=args.chunk_size,
+            pre=min(args.duration, 2.0), post=args.duration,
+            replica_ack=True, **knobs,
+        ))
+        print(json.dumps(metrics) if args.json else
+              "\n".join(f"{k}: {v}" for k, v in metrics.items()))
+        violations = failover_check(metrics) if args.smoke else []
+        for v in violations:
+            print(f"FAILOVER FAIL: {v}", file=sys.stderr)
+        return 1 if violations else 0
     if args.scenario == "crash":
         metrics = asyncio.run(run_crash(
             args.miners, max(2, args.clients // 2),
@@ -744,7 +1129,10 @@ def main(argv=None) -> int:
         args.duration = min(args.duration, 2.0)
     metrics = asyncio.run(run_load(
         args.miners, args.clients, args.duration,
-        chunk_size=args.chunk_size, journal_path=args.journal, **knobs,
+        chunk_size=args.chunk_size, journal_path=args.journal,
+        journal_tick_flush=args.journal_flush == "tick",
+        standby=args.standby, replica_ack=args.replica_ack,
+        miner_delay=args.miner_delay, **knobs,
     ))
     print(json.dumps(metrics) if args.json else
           "\n".join(f"{k}: {v}" for k, v in metrics.items()))

@@ -23,6 +23,7 @@ from tpuminter.protocol import (
     Result,
     Setup,
     Request,
+    WalBatch,
     decode_msg,
     encode_msg,
     payload_is_binary,
@@ -79,6 +80,17 @@ GOLDEN = [
     (
         Join(backend="cpu"),  # codec defaults to "json" → flags 0
         struct.pack("<BBIQ16s", 0xB5, 0, 1, 0, b"cpu"),
+    ),
+    # WAL-shipping batch (ISSUE 5): the one variable-length kind —
+    # tag ‖ offset:u64 ‖ raw journal bytes ‖ crc32. Riding in GOLDEN
+    # puts it under the same exhaustive corruption/truncation sweeps.
+    (
+        WalBatch(offset=13, data=b"\x01\x02raw-journal-bytes"),
+        struct.pack("<BQ", 0xB8, 13) + b"\x01\x02raw-journal-bytes",
+    ),
+    (
+        WalBatch(offset=2**64 - 1, data=b""),
+        struct.pack("<BQ", 0xB8, 2**64 - 1),
     ),
 ]
 
@@ -201,7 +213,10 @@ def test_every_truncation_raises_protocol_error():
 
 def test_unknown_tags_raise():
     for tag in range(256):
-        if tag in (0xB1, 0xB2, 0xB3, 0xB4, 0xB5, 0x7B):
+        # 0xB8 (WalBatch) is variable-length and a 17-byte body with a
+        # valid CRC IS a well-formed (if empty-ish) batch — covered by
+        # its own golden vector + corruption sweep below
+        if tag in (0xB1, 0xB2, 0xB3, 0xB4, 0xB5, 0xB8, 0x7B):
             continue
         body = bytes([tag]) + b"\x00" * 16
         with pytest.raises(ProtocolError):

@@ -525,15 +525,81 @@ def test_journal_truncation_yields_only_a_clean_prefix(records, data):
     assert clean <= keep
 
 
+def _state_key(state):
+    return (
+        state.boot_epoch, state.next_job_id,
+        {j: (tuple(job.remaining), job.best, job.hashes_done)
+         for j, job in state.jobs.items()},
+        dict(state.winners),
+    )
+
+
 @settings(max_examples=60, deadline=None)
 @given(_journal_records)
 def test_journal_double_replay_idempotent(records):
-    def key(state):
-        return (
-            state.boot_epoch, state.next_job_id,
-            {j: (tuple(job.remaining), job.best, job.hashes_done)
-             for j, job in state.jobs.items()},
-            dict(state.winners),
-        )
+    assert _state_key(replay(records)) == _state_key(
+        replay(records + records)
+    )
 
-    assert key(replay(records)) == key(replay(records + records))
+
+# ---------------------------------------------------------------------------
+# WAL shipping stream (tpuminter.replication): the journal corruption
+# contract over the wire, plus standby ingestion invariants
+# (deterministic mirrors live in tests/test_replication.py — this image
+# lacks hypothesis)
+# ---------------------------------------------------------------------------
+
+from tpuminter.journal import RecoveredState, scan_with_cursor  # noqa: E402
+from tpuminter.protocol import WalBatch  # noqa: E402
+
+
+@settings(max_examples=80)
+@given(_journal_records, st.data())
+def test_shipped_batch_corruption_applies_only_an_exact_prefix(
+    records, data
+):
+    """The standby scans every shipped batch before touching its
+    shadow: a 1-byte flip anywhere in the batch must yield an exact
+    record prefix (corruption on the link can only look like loss of a
+    suffix — the resumed stream re-ships the rest)."""
+    blob = bytearray(b"".join(encode_record(r) for r in records))
+    i = data.draw(st.integers(0, len(blob) - 1))
+    blob[i] ^= data.draw(st.integers(1, 255))
+    got, clean, _last = scan_with_cursor(bytes(blob))
+    assert got == records[: len(got)]
+    assert clean <= len(blob)
+
+
+@settings(max_examples=60, deadline=None)
+@given(_journal_records, st.data())
+def test_incremental_shadow_apply_equals_full_replay(records, data):
+    """Standby ingestion applies records batch-by-batch as they ship;
+    wherever the batch boundaries fall, the shadow must equal replaying
+    the stream at once — so a cursor-resumed standby that replays no
+    record twice converges on the same state (and min-folds keep the
+    double-apply case idempotent regardless)."""
+    shadow = RecoveredState()
+    i = 0
+    while i < len(records):
+        step = data.draw(st.integers(1, 4))
+        for rec in records[i : i + step]:
+            shadow.apply(rec)
+        i += step
+    assert _state_key(shadow) == _state_key(replay(records))
+
+
+@settings(max_examples=60)
+@given(
+    st.integers(0, 2**64 - 1), st.binary(max_size=600), st.data()
+)
+def test_walbatch_envelope_corruption_raises_never_misparses(
+    offset, payload, data
+):
+    """The shipping envelope itself (binary tag 0xB8) is under the same
+    corruption contract as every other binary message: any single-byte
+    flip raises ProtocolError, never a different batch."""
+    wire = bytearray(encode_msg(WalBatch(offset, payload), binary=True))
+    i = data.draw(st.integers(0, len(wire) - 1))
+    wire[i] ^= data.draw(st.integers(1, 255))
+    with pytest.raises(ProtocolError):
+        decode_msg(bytes(wire))

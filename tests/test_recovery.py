@@ -330,10 +330,10 @@ def test_journal_disk_failure_fails_loudly_but_never_wedges_replies(
     async def scenario():
         journal, _ = Journal.open(path)
 
-        def boom(buf, need_sync):
+        def boom(blob, need_sync):
             raise OSError(28, "No space left on device")
 
-        monkeypatch.setattr(journal, "_encode_write_sync", boom)
+        monkeypatch.setattr(journal, "_write_sync", boom)
         fired = []
         journal.append(
             "finish", {"id": 1, "ckey": "", "cjid": 1, "mode": "min",

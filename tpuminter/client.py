@@ -43,6 +43,7 @@ async def submit(
     base_backoff: float = 0.2,
     max_backoff: float = 5.0,
     rng: Optional[random.Random] = None,
+    addrs: Optional[list] = None,
 ) -> Result:
     """Connect, submit ``request``, and await its final Result.
 
@@ -57,15 +58,30 @@ async def submit(
     client gets exactly one answer no matter how many times either
     side dies in between. ``reconnect`` without an explicit
     ``client_key`` mints a random one for this call.
+
+    ``addrs`` (ISSUE 5) lists every coordinator address, primary first,
+    standbys after: each failure rotates the redial to the next one, so
+    a re-submission reaches a promoted standby — whose replicated
+    winners table / recovered jobs deduplicate it — with no client-side
+    state beyond the address list. Supersedes ``host``/``port``.
     """
     if client_key is None and reconnect:
         client_key = secrets.token_hex(8)
     if client_key:
         request = dc_replace(request, client_key=client_key)
+    from tpuminter.replication import dial_patience
+
+    targets = list(addrs) if addrs else [(host, port)]
+    connect_epochs = dial_patience(targets)
+    attempt = 0
     delays = jittered_backoff(base_backoff, max_backoff, rng)
     while True:
+        h, p = targets[attempt % len(targets)]
+        attempt += 1
         try:
-            client = await LspClient.connect(host, port, params or FAST)
+            client = await LspClient.connect(
+                h, p, params or FAST, connect_epochs=connect_epochs
+            )
         except LspConnectError:
             if not reconnect:
                 raise
@@ -88,7 +104,8 @@ async def submit(
             wait = next(delays)
             log.info(
                 "client: coordinator lost mid-job; re-submitting job %d "
-                "in %.2fs", request.job_id, wait,
+                "to %s:%d in %.2fs", request.job_id,
+                *targets[attempt % len(targets)], wait,
             )
             await asyncio.sleep(wait)
         finally:
@@ -100,9 +117,20 @@ def main(argv: Optional[list] = None) -> None:
     import argparse
 
     parser = argparse.ArgumentParser(description="tpuminter client")
-    parser.add_argument("hostport", help="coordinator address, host:port")
+    parser.add_argument(
+        "hostport", nargs="?", default=None,
+        help="coordinator address, host:port — or a comma-separated "
+        "list host:port,host:port (primary first, hot standbys after; "
+        "needs --reconnect, which rotates the redial across the list "
+        "so a re-submission lands on a promoted standby)",
+    )
+    parser.add_argument(
+        "--coordinator", metavar="LIST", default=None,
+        help="alias for the positional address list (matches the "
+        "worker CLI)",
+    )
     parser.add_argument("message", nargs="?", help="toy-mode payload string")
-    parser.add_argument("max_nonce", nargs="?", type=int, help="toy-mode nonce bound")
+    parser.add_argument("max_nonce", nargs="?", help="toy-mode nonce bound")
     parser.add_argument("--header", help="TARGET mode: 160-hex-char block header")
     parser.add_argument("--bits", type=lambda s: int(s, 0), default=0x1D00FFFF,
                         help="TARGET mode: compact difficulty bits (default diff-1)")
@@ -145,7 +173,36 @@ def main(argv: Optional[list] = None) -> None:
     args = parser.parse_args(argv)
     if args.timeout is not None and args.timeout <= 0:
         parser.error("--timeout must be positive seconds")
-    host, _, port = args.hostport.rpartition(":")
+    from tpuminter.replication import parse_addr_list
+
+    if args.coordinator is not None:
+        # --coordinator frees the positional address slot, so the
+        # remaining positionals left-shift into the toy-mode pair
+        if args.max_nonce is not None:
+            parser.error(
+                "too many positionals with --coordinator: expected "
+                "[<message> <maxNonce>]"
+            )
+        toy_message, toy_max_nonce = args.hostport, args.message
+        addrs = parse_addr_list(args.coordinator)
+    elif args.hostport is not None:
+        toy_message, toy_max_nonce = args.message, args.max_nonce
+        addrs = parse_addr_list(args.hostport)
+    else:
+        parser.error(
+            "need a coordinator address (positional or --coordinator)"
+        )
+    if toy_max_nonce is not None:
+        try:
+            toy_max_nonce = int(toy_max_nonce)
+        except ValueError:
+            parser.error(f"maxNonce must be an integer, got {toy_max_nonce!r}")
+    if len(addrs) > 1 and not args.reconnect:
+        parser.error(
+            "an address list only makes sense with --reconnect (the "
+            "rotation happens on redial)"
+        )
+    host, port = addrs[0]
     logging.basicConfig(level=logging.WARNING)
 
     def _hex(value: str, what: str) -> bytes:
@@ -196,13 +253,13 @@ def main(argv: Optional[list] = None) -> None:
             target=chain.bits_to_target(args.bits),
             **rolled,
         )
-    elif args.message is not None and args.max_nonce is not None:
+    elif toy_message is not None and toy_max_nonce is not None:
         request = Request(
             job_id=1,
             mode=PowMode.MIN,
             lower=0,
-            upper=args.max_nonce,
-            data=args.message.encode(),
+            upper=toy_max_nonce,
+            data=toy_message.encode(),
         )
     else:
         parser.error("need either <message> <maxNonce> or --header")
@@ -213,9 +270,10 @@ def main(argv: Optional[list] = None) -> None:
             # block-forever default is preserved unless --timeout is given
             result = await asyncio.wait_for(
                 submit(
-                    host or "127.0.0.1", int(port), request,
+                    host, port, request,
                     client_key=args.client_key,
                     reconnect=args.reconnect,
+                    addrs=addrs,
                 ),
                 args.timeout,
             )

@@ -84,6 +84,9 @@ __all__ = [
     "encode_settle",
     "decode_settle",
     "scan",
+    "scan_with_cursor",
+    "read_span",
+    "cursor_valid",
     "replay",
     "merge_ranges",
     "subtract_range",
@@ -183,8 +186,20 @@ def scan(data: bytes) -> Tuple[List[dict], int]:
     would have unframed) is treated as lost — the recovery caller
     truncates the file there.
     """
+    records, clean, _last = scan_with_cursor(data)
+    return records, clean
+
+
+def scan_with_cursor(data: bytes) -> Tuple[List[dict], int, int]:
+    """:func:`scan`, plus the byte offset at which the LAST clean record
+    starts (``-1`` when no record decoded). ``(clean, last_start,
+    crc-at-last_start)`` is the replication resume cursor: a standby
+    derives it by scanning its own shipped copy, and the primary can
+    validate it against its file without replaying anything
+    (:func:`cursor_valid`)."""
     records: List[dict] = []
     off = 0
+    last_start = -1
     total = len(data)
     while total - off >= _REC.size:
         size, crc = _REC.unpack_from(data, off)
@@ -200,7 +215,7 @@ def scan(data: bytes) -> Tuple[List[dict], int]:
             if obj is None:
                 break
             records.append(obj)
-            off = end
+            last_start, off = off, end
             continue
         try:
             obj = json.loads(payload)
@@ -209,8 +224,42 @@ def scan(data: bytes) -> Tuple[List[dict], int]:
         if not isinstance(obj, dict) or "k" not in obj:
             break
         records.append(obj)
-        off = end
-    return records, off
+        last_start, off = off, end
+    return records, off, last_start
+
+
+def read_span(path: str, offset: int, limit: int) -> bytes:
+    """Read up to ``limit`` raw journal bytes starting at ``offset`` —
+    the replication primary's tail-follow reader (the file is the
+    backlog; the live :attr:`Journal.on_batch` hook only has to say
+    "there is more")."""
+    with open(path, "rb") as fh:
+        fh.seek(offset)
+        return fh.read(limit)
+
+
+def cursor_valid(path: str, offset: int, last_start: int, crc: int) -> bool:
+    """Check a standby's resume cursor against this file WITHOUT
+    replaying it: the record starting at ``last_start`` must frame to
+    exactly ``offset`` and carry stored CRC ``crc``. A compaction (or
+    any divergence) fails the check and forces a full resync from 0;
+    ``offset == 0`` is always valid (nothing to resume)."""
+    if offset == 0:
+        return True
+    if not 0 <= last_start < offset:
+        return False
+    try:
+        with open(path, "rb") as fh:
+            if fh.seek(0, os.SEEK_END) < offset:
+                return False
+            fh.seek(last_start)
+            head = fh.read(_REC.size)
+    except OSError:
+        return False
+    if len(head) != _REC.size:
+        return False
+    size, stored_crc = _REC.unpack(head)
+    return last_start + _REC.size + size == offset and stored_crc == crc
 
 
 # ---------------------------------------------------------------------------
@@ -442,6 +491,24 @@ class Journal:
         self.snapshot_provider: Optional[Callable[[], dict]] = None
         self._bytes_since_compact = 0
         self._fsync_slow = False  # sticky: see INLINE_FSYNC_BUDGET_S
+        #: absolute length of the clean on-disk prefix — the replication
+        #: shipping offset space (maintained by every write/compaction)
+        self.size = 0
+        #: bumped on every compaction: offsets from an older generation
+        #: are meaningless, so a live shipper restarts its stream at 0
+        self.generation = 0
+        #: replication ship hook: called ON THE EVENT LOOP with
+        #: ``(start_offset, blob)`` after each flushed batch reaches the
+        #: file — WAL shipping therefore piggybacks on exactly the
+        #: batches the flusher already coalesces (no extra wakeups, no
+        #: second encoding; tpuminter.replication)
+        self.on_batch: Optional[Callable[[int, bytes], None]] = None
+        #: serve-tick flush mode (PERF.md §Round 10): the owner's serve
+        #: loop calls :meth:`flush_tick` once per event burst and the
+        #: flusher task is not spawned per append — only a rare fallback
+        #: timer covers appends that happen outside serve ticks
+        self.tick_flush = False
+        self._tick_timer_armed = False
         self.stats = {
             "records": 0,
             "flushes": 0,
@@ -470,6 +537,7 @@ class Journal:
         journal = cls(path, **kwargs)
         journal.boot_epoch = state.boot_epoch
         journal._fh = open(path, "ab")
+        journal.size = journal._fh.tell()
         # the boot record is durable BEFORE the server advertises the
         # epoch: a crash right after startup must not reuse it
         journal._write_sync(
@@ -477,6 +545,24 @@ class Journal:
         )
         journal.stats["records"] += 1
         return journal, state
+
+    @classmethod
+    def adopt(cls, path: str, epoch: int, **kwargs) -> "Journal":
+        """Open ``path`` WITHOUT scanning or replaying it — the
+        replay-free takeover path: a promoted standby already holds the
+        live shadow state its local WAL replays to (it applied every
+        shipped record as it arrived) and guarantees the file is a
+        clean record prefix. Writes the fencing ``boot`` record with
+        the caller's (strictly higher, see replication.FENCE_JUMP)
+        ``epoch`` durably before returning, exactly like :meth:`open`.
+        """
+        journal = cls(path, **kwargs)
+        journal.boot_epoch = epoch
+        journal._fh = open(path, "ab")
+        journal.size = journal._fh.tell()
+        journal._write_sync(encode_record({"k": "boot", "epoch": epoch}), True)
+        journal.stats["records"] += 1
+        return journal
 
     # -- append path -----------------------------------------------------
 
@@ -524,13 +610,69 @@ class Journal:
 
     def _kick(self) -> None:
         try:
-            asyncio.get_running_loop()
+            loop = asyncio.get_running_loop()
         except RuntimeError:
             # no loop (unit-level drives): write through synchronously
             self._flush_buffered_sync()
             return
+        if self.tick_flush:
+            # serve-tick mode (PERF.md §Round 10): the owner's serve
+            # loop calls flush_tick at each burst end — no flusher task
+            # per append. The timer is the backstop for appends made
+            # outside a serve tick (offloaded-verification settles).
+            if not self._tick_timer_armed:
+                self._tick_timer_armed = True
+                loop.call_later(BATCH_WINDOW_S, self._tick_fallback)
+            return
         if self._flush_task is None or self._flush_task.done():
             self._flush_task = asyncio.ensure_future(self._flush_loop())
+
+    def _tick_fallback(self) -> None:
+        self._tick_timer_armed = False
+        self.flush_tick()
+
+    def flush_tick(self) -> None:
+        """Serve-tick flusher: the owner calls this once per event
+        burst. A callback-free batch is written INLINE right here — no
+        flusher task, no batch-window wakeup; the serve loop's burst
+        cadence IS the batching (the ROADMAP lever for the flusher's
+        event-loop coupling). A batch gating a winner acknowledgement
+        (or a due compaction) still takes the task path for its
+        fsync/executor tiers."""
+        if not self._buffer or self._closed or self._crashed or self._failed:
+            return
+        if self._flush_task is not None and not self._flush_task.done():
+            return  # an fsync/compaction flush is mid-flight; it drains
+        if any(cb is not None for _, cb in self._buffer) or (
+            self.snapshot_provider is not None
+            and self._bytes_since_compact > self._compact_bytes
+        ):
+            self._flush_task = asyncio.ensure_future(self._flush_loop())
+            return
+        buf, self._buffer = self._buffer, []
+        start = self.size
+        try:
+            blob = self._encode_batch(buf)
+            self._write_sync(blob, False)
+        except (OSError, ValueError):
+            self._failed = True
+            log.exception(
+                "journal write to %s FAILED — journaling disabled, "
+                "durability is LOST for this incarnation; replies "
+                "continue undurable", self.path,
+            )
+            return
+        self._ship(start, blob)
+
+    def _ship(self, start: int, blob: bytes) -> None:
+        """Hand one on-disk batch to the replication hook (start offset
+        ‖ raw framed bytes). A broken hook must not kill the WAL."""
+        if self.on_batch is not None:
+            try:
+                self.on_batch(start, blob)
+            except Exception:
+                log.exception("journal on_batch hook failed; detaching it")
+                self.on_batch = None
 
     @staticmethod
     def _encode_batch(buf) -> bytes:
@@ -544,7 +686,10 @@ class Journal:
         buf, self._buffer = self._buffer, []
         if not buf:
             return
-        self._write_sync(self._encode_batch(buf), True)
+        start = self.size
+        blob = self._encode_batch(buf)
+        self._write_sync(blob, True)
+        self._ship(start, blob)
         for _, cb in buf:
             if cb is not None:
                 cb()
@@ -563,29 +708,36 @@ class Journal:
         flush, same discipline as the verification offload."""
         loop = asyncio.get_running_loop()
         while self._buffer and not self._crashed and not self._closed:
-            if all(cb is None for _, cb in self._buffer):
+            if not self.tick_flush and all(
+                cb is None for _, cb in self._buffer
+            ):
                 # no durability callback waiting: let the burst
                 # grow for one batch window — one write per window
-                # instead of one per event-loop tick
+                # instead of one per event-loop tick. (Serve-tick mode
+                # never waits here: the serve loop's burst cadence is
+                # the batching.)
                 await asyncio.sleep(BATCH_WINDOW_S)
             buf, self._buffer = self._buffer, []
             if not buf:
                 continue
             need_sync = any(cb is not None for _, cb in buf)
+            start = self.size
+            blob = b""
             try:
+                blob = self._encode_batch(buf)
                 if need_sync and self._fsync and self._fsync_slow:
                     await loop.run_in_executor(
-                        None, self._encode_write_sync, buf, True
+                        None, self._write_sync, blob, True
                     )
                 elif need_sync and self._fsync:
                     # fast-disk fsync runs inline (INLINE_FSYNC_BUDGET_S)
                     t0 = time.perf_counter()
-                    self._encode_write_sync(buf, True)
+                    self._write_sync(blob, True)
                     if time.perf_counter() - t0 > INLINE_FSYNC_BUDGET_S:
                         self._fsync_slow = True
                     await asyncio.sleep(0)
                 else:
-                    self._encode_write_sync(buf, False)
+                    self._write_sync(blob, False)
                     # yield one tick so the next burst batches up
                     await asyncio.sleep(0)
             except (OSError, ValueError):
@@ -603,6 +755,8 @@ class Journal:
                     "durability is LOST for this incarnation; replies "
                     "continue undurable", self.path,
                 )
+            if not self._failed and not self._crashed:
+                self._ship(start, blob)
             for _, cb in buf:
                 if cb is not None:
                     try:
@@ -634,7 +788,7 @@ class Journal:
                     {"k": "boot", "epoch": self.boot_epoch}
                 ) + encode_record(snap)
                 try:
-                    await loop.run_in_executor(
+                    swapped = await loop.run_in_executor(
                         None, self._compact_sync, blob
                     )
                 except (OSError, ValueError):
@@ -646,9 +800,17 @@ class Journal:
                         "disabled for this incarnation", self.path,
                     )
                     return
-
-    def _encode_write_sync(self, buf, need_sync: bool) -> None:
-        self._write_sync(self._encode_batch(buf), need_sync)
+                if swapped:
+                    # the offset-space switch happens HERE, on the loop:
+                    # size and generation move as one atomic step, so a
+                    # concurrent reader (the replica-ack gate reads both
+                    # to place a target in the right space) can never
+                    # observe the new size under the old generation or
+                    # vice versa
+                    self.size = len(blob)
+                    self.generation += 1
+                    self._bytes_since_compact = 0
+                    self.stats["compactions"] += 1
 
     def _write_sync(self, blob: bytes, need_sync: bool) -> None:
         if self._crashed:
@@ -658,13 +820,22 @@ class Journal:
         if self._fsync and need_sync:
             os.fsync(self._fh.fileno())
             self.stats["syncs"] += 1
+        self.size += len(blob)
         self.stats["flushes"] += 1
         self.stats["bytes"] += len(blob)
         self._bytes_since_compact += len(blob)
 
-    def _compact_sync(self, blob: bytes) -> None:
+    def _compact_sync(self, blob: bytes) -> bool:
+        """Executor half of compaction: the file swap only. ``size`` /
+        ``generation`` — the offsets a live shipper and the replica-ack
+        gates read from the event loop — are applied by the awaiting
+        flush loop, so the pair never tears across threads. Every
+        shipped offset becomes meaningless at that switch: a live
+        shipper sees the generation change and restarts its stream at 0
+        (the compacted file IS a boot+snapshot, so the resync is
+        small)."""
         if self._crashed:
-            return
+            return False
         tmp = self.path + ".compact"
         with open(tmp, "wb") as fh:
             fh.write(blob)
@@ -674,18 +845,20 @@ class Journal:
         os.replace(tmp, self.path)
         self._fh.close()
         self._fh = open(self.path, "ab")
-        self._bytes_since_compact = 0
-        self.stats["compactions"] += 1
+        return True
 
     async def flush(self) -> None:
         """Drain the buffer (tests; close uses it too)."""
         while self._buffer or (
             self._flush_task is not None and not self._flush_task.done()
         ):
-            self._kick()
+            if self.tick_flush:
+                self.flush_tick()  # a tick-mode kick only arms a timer
+            else:
+                self._kick()
             if self._flush_task is not None:
                 await asyncio.gather(self._flush_task, return_exceptions=True)
-            if not self._buffer:
+            if self._failed or not self._buffer:
                 break
 
     async def aclose(self) -> None:

@@ -59,8 +59,16 @@ class LspClient:
         params: Optional[Params] = None,
         *,
         seed: Optional[int] = None,
+        connect_epochs: Optional[int] = None,
     ) -> "LspClient":
-        """Dial the server; raises LspConnectError after epoch_limit epochs."""
+        """Dial the server; raises LspConnectError after epoch_limit epochs.
+
+        ``connect_epochs`` overrides the DIAL patience only (session
+        liveness still uses ``params.epoch_limit``): a role rotating
+        through a coordinator address list (ISSUE 5 failover) wants a
+        dead address to fail fast — each epoch retransmits the CONNECT,
+        so 2 epochs still tolerates one lost datagram — while a live
+        session keeps the full silence tolerance."""
         self = cls()
         self._params = params or Params()
         self._server_addr = (host, port)
@@ -69,7 +77,7 @@ class LspClient:
         self._connect_waiter = loop.create_future()
         connect_frame = encode(Frame(MsgType.CONNECT, 0, 0))
         try:
-            for _ in range(self._params.epoch_limit):
+            for _ in range(connect_epochs or self._params.epoch_limit):
                 self._endpoint.send(connect_frame, self._server_addr)
                 # NOT wait_for(shield(...)): on this Python vintage
                 # wait_for SWALLOWS an external Task.cancel() that races
@@ -92,7 +100,7 @@ class LspClient:
             else:
                 raise lsp.LspConnectError(
                     f"no connect-ack from {host}:{port} after "
-                    f"{self._params.epoch_limit} epochs"
+                    f"{connect_epochs or self._params.epoch_limit} epochs"
                 )
         except BaseException:
             # any failed dial — epoch exhaustion OR a cancellation now

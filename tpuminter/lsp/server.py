@@ -217,6 +217,13 @@ class LspServer:
         return self._boot_epoch
 
     @property
+    def params(self) -> Params:
+        """Timing profile this listener runs — the owner's WAL-shipping
+        lanes dial standbys with the same one so the whole deployment
+        agrees on loss horizons."""
+        return self._params
+
+    @property
     def conn_ids(self) -> Tuple[int, ...]:
         return tuple(self._by_id)
 
@@ -253,6 +260,36 @@ class LspServer:
         if conn is None:
             raise ConnectionError(f"conn {conn_id} does not exist (or was lost)")
         conn.write(payload)
+
+    def reject_conn(self, conn_id: int) -> None:
+        """Fencing/rejection seam (tpuminter.replication): drop one
+        connection IMMEDIATELY — no drain, no loss event on our side —
+        and forget its address, so the peer's very next datagram takes
+        the unknown-address path and draws an ``EPOCH_RESET`` ack. The
+        peer's client then declares the connection lost in one round
+        trip: the prompt "you are not welcome here" a fenced-off stale
+        primary (or a miner dialing an un-promoted standby) must see
+        instead of a silence timeout."""
+        conn = self._by_id.get(conn_id)
+        if conn is None:
+            return
+        addr = self._addr_of.get(conn_id)
+        conn.suppress_loss_event = True
+        conn.declare_lost("rejected by owner")
+        self._forget(conn_id)
+        # let the reset fire for this addr even if one was already
+        # spent this epoch on unrelated traffic
+        self._reset_pinged.discard(addr)
+
+    def set_boot_epoch(self, epoch: int) -> None:
+        """Promotion seam (tpuminter.replication): a standby taking
+        over re-brands its listener with the fenced (strictly higher)
+        epoch before the first miner Join — connect-acks and reset
+        acks advertise it from then on. Only meaningful while no
+        ordinary client sessions are live (the standby rejected them
+        all pre-promotion)."""
+        self._boot_epoch = epoch
+        self._reset_pinged.clear()
 
     def close_conn(self, conn_id: int) -> None:
         """Close one client connection: reject further writes, keep the

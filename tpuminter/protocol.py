@@ -76,6 +76,11 @@ __all__ = [
     "Setup",
     "Assign",
     "Refuse",
+    "RepHello",
+    "SyncFrom",
+    "WalStart",
+    "WalBatch",
+    "SyncAck",
     "Message",
     "encode_msg",
     "decode_msg",
@@ -301,7 +306,73 @@ class Cancel:
     job_id: int
 
 
-Message = Union[Join, Request, Result, Cancel, Setup, Assign, Refuse]
+@dataclass(frozen=True)
+class RepHello:
+    """Primary → standby, first message on a WAL-shipping connection:
+    "I am (or claim to be) the coordinator of boot epoch ``epoch``;
+    tell me where to resume". The epoch is the FENCING credential
+    (tpuminter.replication): a standby rejects a hello whose epoch is
+    below the primary it already follows, and a *promoted* standby —
+    whose own epoch jumped a fencing stride ahead — rejects the dead
+    primary's entire restart lineage, so a zombie primary's shipping
+    stream can never corrupt the new coordinator."""
+
+    epoch: int
+
+
+@dataclass(frozen=True)
+class SyncFrom:
+    """Standby → primary: the durable resume cursor, derived by
+    scanning the standby's local WAL copy (``journal.scan_with_cursor``)
+    — ``offset`` bytes are already applied, the last record starts at
+    ``last_start`` and carries stored CRC ``crc``. The primary
+    validates the cursor against its own file (``journal.cursor_valid``)
+    and resumes there, or restarts the stream at 0 when the files have
+    diverged (compaction, corruption)."""
+
+    offset: int
+    last_start: int = -1
+    crc: int = 0
+
+
+@dataclass(frozen=True)
+class WalStart:
+    """Primary → standby: the next :class:`WalBatch` begins at byte
+    ``offset`` of the primary's journal. ``offset == 0`` with local
+    state present means FULL RESYNC: the standby truncates its copy and
+    resets its shadow (the stream re-delivers a boot + snapshot)."""
+
+    offset: int
+
+
+@dataclass(frozen=True)
+class WalBatch:
+    """Primary → standby: ``data`` is a raw slice of the primary's
+    journal file starting at byte ``offset`` — the already-framed
+    length-prefixed+CRC records exactly as the flusher group-committed
+    them (no re-encoding; shipping piggybacks on the WAL's own batch
+    discipline). The standby scans it with the journal codec: a
+    truncated or corrupted batch yields a clean record prefix and the
+    connection resyncs, so corruption can only ever look like loss of
+    a suffix."""
+
+    offset: int
+    data: bytes
+
+
+@dataclass(frozen=True)
+class SyncAck:
+    """Standby → primary: every byte below ``offset`` is applied to the
+    shadow state and written to the standby's local WAL — the seam the
+    replica-acked durability tier gates winner acknowledgements on."""
+
+    offset: int
+
+
+Message = Union[
+    Join, Request, Result, Cancel, Setup, Assign, Refuse,
+    RepHello, SyncFrom, WalStart, WalBatch, SyncAck,
+]
 
 _KINDS = {
     "join": Join,
@@ -311,6 +382,11 @@ _KINDS = {
     "setup": Setup,
     "assign": Assign,
     "refuse": Refuse,
+    "rhello": RepHello,
+    "syncfrom": SyncFrom,
+    "walstart": WalStart,
+    "walbatch": WalBatch,
+    "syncack": SyncAck,
 }
 
 
@@ -331,6 +407,14 @@ _TAG_JOIN = 0xB5
 # 0xB7 is reserved by tpuminter.journal for its packed settle record
 # (same '{'-disjoint tag space, so a journal payload can never be
 # confused with a wire message and vice versa).
+#: WAL-shipping batch (tpuminter.replication): the one VARIABLE-length
+#: binary message — ``tag ‖ offset:u64 ‖ raw journal bytes ‖ crc32``.
+#: The raw bytes are shipped exactly as the journal flusher wrote them
+#: (already length-prefixed + CRC'd per record), so no re-encoding
+#: happens on the hot path. Distinct-length aliasing does not apply to
+#: a variable-length kind; the trailing CRC32 alone carries the
+#: corruption contract (any single-byte flip fails it).
+_TAG_WALBATCH = 0xB8
 
 # Field layouts (little-endian). Every struct is a distinct total size
 # (+4 CRC bytes), so a corrupted tag always fails the length check even
@@ -343,6 +427,7 @@ _BIN_REFUSE = struct.Struct("<BQQ")          # tag, job, chunk
 _BIN_CANCEL = struct.Struct("<BQ")           # tag, job
 _BIN_JOIN = struct.Struct("<BBIQ16s")        # tag, flags, lanes, span,
 #                                              backend (NUL-padded utf8)
+_BIN_WALBATCH_HEAD = struct.Struct("<BQ")    # tag, offset (data follows)
 _CRC = struct.Struct("<I")
 
 _BIN_BY_TAG = {
@@ -424,12 +509,31 @@ def _encode_binary(msg: Message) -> Optional[bytes]:
         return _seal(_BIN_JOIN.pack(
             _TAG_JOIN, flags, msg.lanes, msg.span, backend
         ))
+    if isinstance(msg, WalBatch):
+        if not 0 <= msg.offset < _U64:
+            return None
+        return _seal(
+            _BIN_WALBATCH_HEAD.pack(_TAG_WALBATCH, msg.offset)
+            + bytes(msg.data)
+        )
     return None
 
 
 def _decode_binary(raw) -> Message:
     n = len(raw)
     tag = raw[0]
+    if tag == _TAG_WALBATCH:
+        head = _BIN_WALBATCH_HEAD.size
+        if n < head + _CRC.size:
+            raise ProtocolError(f"walbatch payload truncated: {n} bytes")
+        view = memoryview(raw)
+        if (
+            zlib.crc32(view[: n - _CRC.size])
+            != _CRC.unpack_from(raw, n - _CRC.size)[0]
+        ):
+            raise ProtocolError("binary payload failed its checksum")
+        _, offset = _BIN_WALBATCH_HEAD.unpack_from(raw)
+        return WalBatch(offset, bytes(view[head : n - _CRC.size]))
     layout = _BIN_BY_TAG.get(tag)
     if layout is None:
         raise ProtocolError(f"unknown binary message tag {tag:#04x}")
@@ -573,6 +677,21 @@ def encode_msg(msg: Message, *, binary: bool = False) -> bytes:
         }
     elif isinstance(msg, Cancel):
         obj = {"kind": "cancel", "job_id": msg.job_id}
+    elif isinstance(msg, RepHello):
+        obj = {"kind": "rhello", "epoch": msg.epoch}
+    elif isinstance(msg, SyncFrom):
+        obj = {
+            "kind": "syncfrom", "off": msg.offset,
+            "start": msg.last_start, "crc": msg.crc,
+        }
+    elif isinstance(msg, WalStart):
+        obj = {"kind": "walstart", "off": msg.offset}
+    elif isinstance(msg, WalBatch):
+        # compat long tail only — the shipper always speaks binary
+        obj = {"kind": "walbatch", "off": msg.offset,
+               "data": bytes(msg.data).hex()}
+    elif isinstance(msg, SyncAck):
+        obj = {"kind": "syncack", "off": msg.offset}
     else:
         raise ProtocolError(f"not an app message: {msg!r}")
     return json.dumps(obj, separators=(",", ":")).encode()
@@ -625,6 +744,21 @@ def decode_msg(raw) -> Message:
             )
         if kind == "refuse":
             return Refuse(job_id=int(obj["job_id"]), chunk_id=int(obj["chunk_id"]))
+        if kind == "rhello":
+            return RepHello(epoch=int(obj["epoch"]))
+        if kind == "syncfrom":
+            return SyncFrom(
+                offset=int(obj["off"]), last_start=int(obj.get("start", -1)),
+                crc=int(obj.get("crc", 0)),
+            )
+        if kind == "walstart":
+            return WalStart(offset=int(obj["off"]))
+        if kind == "walbatch":
+            return WalBatch(
+                offset=int(obj["off"]), data=bytes.fromhex(obj["data"])
+            )
+        if kind == "syncack":
+            return SyncAck(offset=int(obj["off"]))
         if kind == "result":
             return Result(
                 job_id=int(obj["job_id"]),

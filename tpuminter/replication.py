@@ -1,0 +1,887 @@
+"""Replicated coordinator: WAL shipping, hot-standby replay, fenced
+failover (ISSUE 5).
+
+PR 3's write-ahead journal closed the *process*-loss gap: ``kill -9``
+re-mines at most a record tail. But the WAL is a file on one machine —
+lose the machine and every un-settled range, acknowledged winner, and
+client binding is gone. This module closes the machine-loss gap the
+same way the boot epoch closed process loss:
+
+**WAL shipping** — the primary coordinator streams its journal to a
+standby over the existing LSP stack. Nothing is re-encoded: a
+:class:`~tpuminter.protocol.WalBatch` carries a raw byte slice of the
+journal file (the already-framed tag-0xB7/JSON records), and shipping
+piggybacks on exactly the batches the journal flusher already
+group-commits (``Journal.on_batch`` fires once per flushed batch, so
+replication adds no wakeups and no second encoding to the hot path).
+The standby validates every batch with the journal codec — a truncated
+or corrupted batch yields a clean record prefix and a resync, so
+corruption on the link can only ever look like *loss of a suffix*,
+exactly like the file, the frames, and the app codec.
+
+**Durable resume cursor** — the standby's local WAL copy IS its cursor:
+at startup it scans the file (``journal.scan_with_cursor``), truncates
+any torn tail, and offers ``offset ‖ last-record-start ‖ CRC of the
+last record`` in its :class:`~tpuminter.protocol.SyncFrom`. The primary
+validates the cursor against its own file without replaying anything
+(``journal.cursor_valid``) and resumes the stream there — a restarted
+standby re-ships only the tail it missed, never a record twice. A
+failed check (the primary compacted, or the files diverged) restarts
+the stream at 0; the compacted file is a boot+snapshot, so even a full
+resync is small.
+
+**Hot-standby replay** — the standby applies each shipped record to a
+live :class:`~tpuminter.journal.RecoveredState` shadow (jobs, settled
+intervals, the winner dedup table) as it arrives. Takeover is therefore
+REPLAY-FREE: :meth:`ReplicationStandby.promote` hands the shadow
+straight to a :class:`~tpuminter.coordinator.Coordinator` and opens the
+local WAL with ``Journal.adopt`` (append-only, no rescan).
+
+**Fenced failover** — promotion activates a boot epoch a whole
+:data:`FENCE_JUMP` stride above the dead primary's, so the old
+primary's entire restart lineage (each ``Journal.open`` bumps +1) stays
+below it. The fencing rule is *higher epoch wins*: a coordinator (or an
+un-promoted standby) rejects any :class:`~tpuminter.protocol.RepHello`
+whose epoch does not beat what it already follows/owns —
+``LspServer.reject_conn`` drops the connection and forgets the address,
+so the zombie's next datagram draws an ``EPOCH_RESET`` ack and its LSP
+client declares the connection lost in one round trip. Miners and
+clients reach whichever coordinator is alive via the existing
+reconnect/re-submit paths given an address list (``--coordinator
+host:port,host:port``): the un-promoted standby rejects their dials the
+same way, so the fleet keeps rotating until promotion, then lands.
+
+CLI (the standby/takeover role)::
+
+    python -m tpuminter.replication <primary-host:port> --wal standby.wal \
+        --port 9100 --promote-after 3
+
+ships the primary's WAL into ``standby.wal`` and, once the primary has
+been silent past ``--promote-after`` seconds, promotes: the process
+becomes the coordinator on ``--port`` with a fenced epoch.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from typing import Callable, List, Optional, Tuple
+
+from tpuminter.journal import (
+    Journal,
+    RecoveredState,
+    cursor_valid,
+    read_span,
+    scan_with_cursor,
+)
+from tpuminter.lsp import (
+    LspClient,
+    LspConnectError,
+    LspConnectionLost,
+    LspServer,
+    Params,
+)
+from tpuminter.lsp.params import FAST, jittered_backoff
+from tpuminter.protocol import (
+    ProtocolError,
+    RepHello,
+    SyncAck,
+    SyncFrom,
+    WalBatch,
+    WalStart,
+    decode_msg,
+    encode_msg,
+)
+
+__all__ = [
+    "FENCE_JUMP",
+    "SHIP_BATCH_BYTES",
+    "ReplicationPrimary",
+    "ReplicationStandby",
+    "dial_patience",
+    "gate_any",
+    "parse_addr_list",
+    "main",
+]
+
+log = logging.getLogger("tpuminter.replication")
+
+#: Epoch stride a promoted standby jumps ahead of the primary it
+#: replaces. ``Journal.open`` bumps the epoch by 1 per restart, so the
+#: dead primary's restart lineage stays fenced below the new
+#: coordinator for this many restarts — far beyond any plausible
+#: operator mistake, while keeping epochs small monotone integers.
+FENCE_JUMP = 1 << 16
+
+#: Largest journal slice per WalBatch. Bounded well under the LSP
+#: reassembly cap (connection.MAX_MESSAGE, 1 MiB) so a batch is a few
+#: hundred frames at most; backlog catch-up ships a sequence of these.
+SHIP_BATCH_BYTES = 192 * 1024
+
+#: Tail-follow coalescing window: after the journal signals new bytes,
+#: the shipper waits this long before reading the tail, so several of
+#: the flusher's own batches travel as ONE WalBatch (and draw one
+#: standby scan/apply/write/ack instead of one per flush). Measured on
+#: the fleet-8 colocated run: per-batch shipping at the flush cadence
+#: cost ~35% of results/s; coalescing is the difference between that
+#: and the §Round 10 figure. Replication lag grows by at most this
+#: much — noise against the 1.25 s loss horizon.
+SHIP_COALESCE_S = 0.01
+
+
+def dial_patience(targets) -> Optional[int]:
+    """The shared dial policy for an address-rotating fleet
+    (``--coordinator host:port,host:port``): probe each address with
+    2-connect-epoch patience — a dead primary must cost a fraction of
+    the loss horizon, not a full session ``epoch_limit``, or takeover
+    latency is dominated by dial patience (measured ~1.4 s → ~70 ms in
+    the §Round 10 drill). A single-address dial keeps the session
+    default (``None``): there is nowhere to rotate to, so patience is
+    free. Every rotating redial loop (worker, client, loadgen) takes
+    the number from here so the policy tunes in one place."""
+    return 2 if len(targets) > 1 else None
+
+
+def parse_addr_list(spec: str) -> List[Tuple[str, int]]:
+    """Parse ``host:port[,host:port...]`` (the ``--coordinator`` flag's
+    shape) into an address list; a bare ``:port`` means localhost."""
+    addrs: List[Tuple[str, int]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port = part.rpartition(":")
+        addrs.append((host or "127.0.0.1", int(port)))
+    if not addrs:
+        raise ValueError(f"no coordinator addresses in {spec!r}")
+    return addrs
+
+
+# ---------------------------------------------------------------------------
+# primary side: ship the WAL to one standby
+# ---------------------------------------------------------------------------
+
+class ReplicationPrimary:
+    """One primary→standby shipping lane, owned by the primary
+    coordinator (one instance per standby address). Dials the standby
+    with jittered backoff, offers its boot epoch
+    (:class:`~tpuminter.protocol.RepHello`), honors the standby's
+    resume cursor, ships the file backlog, then follows the journal
+    live off ``Journal.on_batch``. Stops for good — loudly — when the
+    standby fences it off (a promoted standby answered RESET: this
+    process is a zombie of a failed-over epoch and must not keep
+    claiming to be the coordinator's WAL source)."""
+
+    def __init__(
+        self,
+        journal: Journal,
+        host: str,
+        port: int,
+        *,
+        params: Optional[Params] = None,
+    ):
+        self._journal = journal
+        self._host = host
+        self._port = port
+        self._params = params or FAST
+        self._task: Optional[asyncio.Task] = None
+        self._stopped = False
+        #: a promoted standby refused our epoch: we are a zombie
+        self.fenced = False
+        self.last_loss_reason: Optional[str] = None
+        #: bytes the standby has confirmed applied (SyncAck high water)
+        #: — an offset in the *stream's* space, i.e. generation
+        #: :attr:`_gen`; a compaction moves ``journal.generation`` ahead
+        #: of it until the session resyncs
+        self.acked = 0
+        self._gen = journal.generation
+        #: bytes shipped in the current stream — the sanity bound for
+        #: acks (a stale pre-compaction SyncAck racing the WalStart(0)
+        #: resync would otherwise poison :attr:`acked` in the new space)
+        self._shipped = 0
+        #: True while a session is live and the backlog has been shipped
+        self.synced = False
+        self._wake = asyncio.Event()
+        #: replica-ack waiters: (generation, target_offset, callback),
+        #: fired in :meth:`_on_ack` order (see :func:`gate_any`); the
+        #: generation pins which offset space the target lives in
+        self._gates: List[Tuple[int, int, Callable[[], None]]] = []
+        self.stats = {
+            "batches_shipped": 0,
+            "bytes_shipped": 0,
+            "resyncs": 0,
+            "sessions": 0,
+        }
+        prev = journal.on_batch
+
+        def hook(start: int, blob: bytes, _prev=prev) -> None:
+            if _prev is not None:
+                _prev(start, blob)
+            self._wake.set()
+
+        journal.on_batch = hook
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._run())
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self._task is not None:
+            self._task.cancel()
+            await asyncio.gather(self._task, return_exceptions=True)
+        self._fire_gates("replication stopped")
+
+    def crash(self) -> None:
+        """kill -9 seam: stop shipping with no goodbye (the simulated
+        machine loss the failover drill inflicts)."""
+        self._stopped = True
+        if self._task is not None:
+            self._task.cancel()
+
+    # -- replica-acked durability tier ----------------------------------
+
+    def gate(self, target: int, cb: Callable[[], None]) -> bool:
+        """Register ``cb`` to fire once the standby has acked past
+        byte ``target`` (an offset in the journal's CURRENT generation);
+        returns False (caller fires immediately) when no synced standby
+        session exists — availability over replica durability, the same
+        loud trade the journal's disk-failure path makes."""
+        if not self.synced:
+            return False
+        gen = self._journal.generation
+        if gen == self._gen and self.acked >= target:
+            # already replica-durable — but only if the ack high water
+            # lives in the same offset space as the target: right after
+            # a compaction (journal.generation ahead of the stream's
+            # _gen) a stale acked from the old space must not release a
+            # new-space target
+            return False
+        self._gates.append((gen, target, cb))
+        return True
+
+    def _on_ack(self, offset: int) -> None:
+        if offset > self._shipped:
+            # a stale ack from the pre-compaction stream arriving after
+            # the WalStart(0) resync: its offset is in the old space
+            return
+        if offset > self.acked:
+            self.acked = offset
+        if not self._gates:
+            return
+        due = [
+            cb for g, t, cb in self._gates
+            if g == self._gen and t <= self.acked
+        ]
+        self._gates = [
+            (g, t, cb) for g, t, cb in self._gates
+            if g != self._gen or t > self.acked
+        ]
+        for cb in due:
+            try:
+                cb()
+            except Exception:
+                log.exception("replica-ack gate callback failed")
+
+    def _switch_generation(self) -> None:
+        """The stream's offset space catches up to the journal's
+        current generation (a compaction landed): reset the ship/ack
+        high waters and re-base gates registered against an older
+        space to the current end of the new file — the compacting
+        snapshot was taken from live coordinator state AFTER their
+        records' durability callbacks fired, so once the standby acks
+        past it (``journal.size`` >= the snapshot length) the gated
+        winners are replica-durable again."""
+        gen = self._journal.generation
+        self._gen = gen
+        self._shipped = 0
+        self.acked = 0
+        self._gates = [
+            (gen, t if g == gen else self._journal.size, cb)
+            for g, t, cb in self._gates
+        ]
+
+    def _fire_gates(self, why: str) -> None:
+        """Session died / shipping stopped: a gated reply must never
+        wedge behind a dead standby — fire everything, loudly."""
+        if not self._gates:
+            return
+        log.warning(
+            "releasing %d replica-ack gated replies without standby "
+            "durability (%s)", len(self._gates), why,
+        )
+        gates, self._gates = self._gates, []
+        for _g, _t, cb in gates:
+            try:
+                cb()
+            except Exception:
+                log.exception("replica-ack gate callback failed")
+
+    # -- the shipping session -------------------------------------------
+
+    async def _run(self) -> None:
+        delays = jittered_backoff(0.1, 2.0)
+        while not self._stopped and not self.fenced:
+            try:
+                client = await LspClient.connect(
+                    self._host, self._port, self._params
+                )
+            except LspConnectError:
+                await asyncio.sleep(next(delays))
+                continue
+            try:
+                self.stats["sessions"] += 1
+                await self._session(client)
+                delays = jittered_backoff(0.1, 2.0)
+            except LspConnectionLost as exc:
+                self.last_loss_reason = str(exc)
+                if "reset ack" in str(exc) or "restarted" in str(exc):
+                    # the standby's listener no longer knows us and told
+                    # us so with a RESET/epoch change — either it
+                    # restarted (redial and re-sync: the cursor protocol
+                    # makes that cheap) or it PROMOTED and fenced our
+                    # epoch off. Redial once: a fenced hello is rejected
+                    # again immediately, which is our stop signal. (A
+                    # standby that crash-loops twice inside the narrow
+                    # hello→SyncFrom window is indistinguishable from a
+                    # fencing rejection and would false-fence this lane;
+                    # accepted — self-fencing only degrades replica
+                    # durability, loudly, and never affects the
+                    # standby-side fencing that actual safety rests on.)
+                    self._resets = getattr(self, "_resets", 0) + 1
+                    if self._resets >= 2:
+                        self.fenced = True
+                        log.error(
+                            "standby %s:%d fenced this primary off "
+                            "(epoch %d rejected twice): this coordinator "
+                            "is a ZOMBIE of a failed-over epoch — WAL "
+                            "shipping stops for good",
+                            self._host, self._port,
+                            self._journal.boot_epoch,
+                        )
+                else:
+                    self._resets = 0
+            except Exception:
+                # a malformed standby reply (ProtocolError), a journal
+                # read error (OSError), or any other bug must not
+                # silently kill the lane for the primary's lifetime —
+                # log it and keep redialing
+                log.exception(
+                    "shipping session to %s:%d failed; redialing",
+                    self._host, self._port,
+                )
+            finally:
+                self.synced = False
+                self._fire_gates("standby session lost")
+                await client.close(drain_timeout=0.2)
+            if not self._stopped and not self.fenced:
+                await asyncio.sleep(next(delays))
+
+    async def _session(self, client: LspClient) -> None:
+        journal = self._journal
+        client.write(encode_msg(RepHello(journal.boot_epoch)))
+        msg = decode_msg(await client.read())
+        if not isinstance(msg, SyncFrom):
+            raise LspConnectionLost(client.conn_id, "expected SyncFrom")
+        # cursor validation: resume where the standby stopped, or — on
+        # any divergence (compaction, different file) — from 0
+        offset = msg.offset
+        if offset > journal.size or not await asyncio.get_running_loop(
+        ).run_in_executor(
+            None, cursor_valid, journal.path, offset, msg.last_start, msg.crc
+        ):
+            offset = 0
+            self.stats["resyncs"] += 1
+        gen = journal.generation
+        client.write(encode_msg(WalStart(offset)))
+        shipped = offset
+        self._gen = gen
+        self._shipped = shipped
+        # the validated cursor is what THIS standby incarnation holds
+        # durably — a previous session's high water must not leak in
+        self.acked = offset
+        self._resets = 0
+        loop = asyncio.get_running_loop()
+
+        async def read_acks() -> None:
+            while True:
+                raw = await client.read()
+                try:
+                    ack = decode_msg(raw)
+                except ProtocolError:
+                    continue
+                if isinstance(ack, SyncAck):
+                    self._on_ack(ack.offset)
+
+        acks = asyncio.ensure_future(read_acks())
+        backlogged = True  # the cursor tail ships without lingering
+        try:
+            while not self._stopped:
+                if gen != journal.generation:
+                    # compaction rewrote the file: every offset we knew
+                    # is stale — restart the stream (small: the new
+                    # file is a boot+snapshot) and move the gates into
+                    # the new offset space
+                    gen = journal.generation
+                    shipped = 0
+                    self._switch_generation()
+                    self.stats["resyncs"] += 1
+                    backlogged = True
+                    client.write(encode_msg(WalStart(0)))
+                if shipped >= journal.size:
+                    self.synced = True
+                    backlogged = False
+                    if acks.done():
+                        acks.result()  # propagate the loss
+                    self._wake.clear()
+                    if shipped >= journal.size and gen == journal.generation:
+                        # follow the tail: woken by the journal's own
+                        # flush batches (no polling; the 0.5 s timeout
+                        # only covers a hook lost to journal failure)
+                        try:
+                            await asyncio.wait_for(self._wake.wait(), 0.5)
+                        except asyncio.TimeoutError:
+                            pass
+                    continue
+                if not backlogged:
+                    # live tail: linger one coalescing window so the
+                    # flusher's next few batches travel in this same
+                    # WalBatch — per-flush shipping measured ~35% of
+                    # fleet-8 results/s on this 1-core host; coalesced
+                    # shipping is the §Round 10 figure
+                    await asyncio.sleep(SHIP_COALESCE_S)
+                want = min(SHIP_BATCH_BYTES, journal.size - shipped)
+                backlogged = journal.size - shipped > want  # more behind
+                if want > 4096:
+                    blob = await loop.run_in_executor(
+                        None, read_span, journal.path, shipped, want
+                    )
+                else:
+                    blob = read_span(journal.path, shipped, want)
+                if gen != journal.generation:
+                    continue  # compacted under the read; resync
+                client.write(encode_msg(
+                    WalBatch(shipped, blob), binary=True
+                ))
+                shipped += len(blob)
+                self._shipped = shipped
+                self.stats["batches_shipped"] += 1
+                self.stats["bytes_shipped"] += len(blob)
+                await asyncio.sleep(0)
+        finally:
+            acks.cancel()
+            await asyncio.gather(acks, return_exceptions=True)
+
+
+# ---------------------------------------------------------------------------
+# standby side: receive, persist, replay live, promote on demand
+# ---------------------------------------------------------------------------
+
+class ReplicationStandby:
+    """The hot standby: an LSP listener that accepts ONE primary's
+    shipping stream, persists it to a local WAL copy, and replays every
+    record into a live shadow state. Anything else that dials it
+    pre-promotion (miners, clients, a stale lower-epoch primary) is
+    rejected via the RESET path, so an address-listed fleet keeps
+    rotating back to the real coordinator until :meth:`promote` turns
+    this process into it."""
+
+    def __init__(self) -> None:
+        self._server: Optional[LspServer] = None
+        self._params = FAST
+        self._apply_shadow = True
+        self.path = ""
+        self._fh = None
+        self.shadow = RecoveredState()
+        #: local clean length + cursor of the last applied record
+        self.size = 0
+        self._last_start = -1
+        self._last_crc = 0
+        self._primary_conn: Optional[int] = None
+        self.primary_epoch = 0
+        self.promoted = False
+        self._run_task: Optional[asyncio.Task] = None
+        #: set whenever the shipping connection is declared lost; the
+        #: failover controller (CLI --promote-after, the loadgen drill)
+        #: keys promotion off it
+        self.primary_lost = asyncio.Event()
+        self.last_contact: Optional[float] = None
+        self.stats = {
+            "batches": 0,
+            "records_applied": 0,
+            "bytes": 0,
+            "resyncs": 0,
+            "rejects": 0,
+            "acks_sent": 0,
+        }
+
+    @classmethod
+    async def create(
+        cls,
+        wal_path: str,
+        *,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        params: Optional[Params] = None,
+        apply_shadow: bool = True,
+    ) -> "ReplicationStandby":
+        """Open (or resume) the local WAL copy at ``wal_path`` — torn
+        tail truncated, records replayed into the shadow, cursor
+        derived — and listen on ``port`` (the address miners/clients
+        list as the failover target; it only starts accepting them
+        after promotion).
+
+        ``apply_shadow=False`` is the measurement seam behind PERF.md
+        §Round 10's per-stage decomposition: the standby still scans,
+        persists, and acks every batch (the durability half) but skips
+        the live shadow replay (the hot-takeover half). Such a sink
+        cannot :meth:`promote`."""
+        self = cls()
+        self.path = wal_path
+        self._apply_shadow = apply_shadow
+        self._params = params or FAST
+        if os.path.exists(wal_path):
+            with open(wal_path, "rb") as fh:
+                data = fh.read()
+            records, clean, last_start = scan_with_cursor(data)
+            if clean < len(data):
+                with open(wal_path, "r+b") as fh:
+                    fh.truncate(clean)
+            if self._apply_shadow:
+                for rec in records:
+                    self.shadow.apply(rec)
+            self.stats["records_applied"] += len(records)
+            self.size = clean
+            self._last_start = last_start
+            if last_start >= 0:
+                self._last_crc = int.from_bytes(
+                    data[last_start + 4 : last_start + 8], "little"
+                )
+        self._fh = open(wal_path, "ab")
+        self._server = await LspServer.create(port, self._params, host=host)
+        return self
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None
+        return self._server.port
+
+    @property
+    def server(self) -> LspServer:
+        assert self._server is not None
+        return self._server
+
+    # -- the receive loop ------------------------------------------------
+
+    async def run(self) -> None:
+        """Serve the shipping link until promoted/cancelled."""
+        self._run_task = asyncio.current_task()
+        while not self.promoted:
+            conn_id, payload = await self._server.read()
+            if payload is None:
+                if conn_id == self._primary_conn:
+                    self._primary_conn = None
+                    self.primary_lost.set()
+                    log.warning(
+                        "standby: primary connection lost (epoch %d)",
+                        self.primary_epoch,
+                    )
+                continue
+            try:
+                msg = decode_msg(payload)
+            except ProtocolError as exc:
+                log.warning("standby: malformed message dropped: %s", exc)
+                continue
+            if isinstance(msg, RepHello):
+                self._on_hello(conn_id, msg)
+            elif conn_id != self._primary_conn:
+                # a miner/client dialed the standby address early, or a
+                # replication message from a conn that never hello'd:
+                # reject so the peer's redial rotation moves on
+                self.stats["rejects"] += 1
+                self._server.reject_conn(conn_id)
+            elif isinstance(msg, WalStart):
+                self._on_start(msg)
+            elif isinstance(msg, WalBatch):
+                self._on_batch(conn_id, msg)
+            else:
+                log.warning(
+                    "standby: unexpected %s from primary",
+                    type(msg).__name__,
+                )
+
+    def _on_hello(self, conn_id: int, msg: RepHello) -> None:
+        if self.promoted or msg.epoch < self.primary_epoch:
+            # fencing: higher epoch wins. A promoted standby IS the
+            # coordinator — its epoch jumped FENCE_JUMP ahead, so the
+            # dead primary's whole restart lineage lands here. An
+            # un-promoted standby likewise refuses to follow an epoch
+            # below the primary it already follows.
+            self.stats["rejects"] += 1
+            log.warning(
+                "standby: REJECTING hello from fenced/stale epoch %d "
+                "(following %d%s)", msg.epoch, self.primary_epoch,
+                ", promoted" if self.promoted else "",
+            )
+            self._server.reject_conn(conn_id)
+            return
+        if self._primary_conn is not None and self._primary_conn != conn_id:
+            # a restarted primary (strictly higher epoch — it replayed
+            # its own journal) supersedes the stale session
+            self._server.reject_conn(self._primary_conn)
+        self._primary_conn = conn_id
+        self.primary_epoch = msg.epoch
+        self.primary_lost.clear()
+        log.info(
+            "standby: following primary epoch %d (cursor offset %d)",
+            msg.epoch, self.size,
+        )
+        self._server.write(conn_id, encode_msg(
+            SyncFrom(self.size, self._last_start, self._last_crc)
+        ))
+
+    def _on_start(self, msg: WalStart) -> None:
+        if msg.offset == self.size:
+            return  # resuming exactly at our cursor: nothing to do
+        if msg.offset == 0:
+            # full resync: the primary compacted or our copies diverged
+            log.info(
+                "standby: full resync (had %d bytes); shadow reset",
+                self.size,
+            )
+            self.stats["resyncs"] += 1
+            self._fh.close()
+            self._fh = open(self.path, "wb")
+            self.size = 0
+            self._last_start = -1
+            self._last_crc = 0
+            self.shadow = RecoveredState()
+            return
+        # a start offset that is neither 0 nor our cursor means the
+        # protocol desynced; drop the conn — the redial resyncs cleanly
+        log.warning(
+            "standby: WalStart at %d but local size is %d; resetting "
+            "the link", msg.offset, self.size,
+        )
+        if self._primary_conn is not None:
+            self._server.reject_conn(self._primary_conn)
+            self._primary_conn = None
+
+    def _on_batch(self, conn_id: int, msg: WalBatch) -> None:
+        self.last_contact = time.monotonic()
+        if msg.offset != self.size:
+            log.warning(
+                "standby: non-contiguous batch at %d (local size %d); "
+                "resetting the link", msg.offset, self.size,
+            )
+            self._server.reject_conn(conn_id)
+            self._primary_conn = None
+            return
+        records, clean, last_start = scan_with_cursor(msg.data)
+        if clean:
+            blob = (
+                msg.data if clean == len(msg.data)
+                else bytes(msg.data[:clean])
+            )
+            self._fh.write(blob)
+            self._fh.flush()
+            if self._apply_shadow:
+                for rec in records:
+                    self.shadow.apply(rec)
+            if last_start >= 0:
+                self._last_start = self.size + last_start
+                self._last_crc = int.from_bytes(
+                    blob[last_start + 4 : last_start + 8], "little"
+                )
+            self.size += clean
+            self.stats["batches"] += 1
+            self.stats["records_applied"] += len(records)
+            self.stats["bytes"] += clean
+        if clean < len(msg.data):
+            # a torn/corrupted shipped batch loses only its suffix —
+            # drop the link; the resumed stream re-ships from the clean
+            # cursor (tests/test_replication.py pins this)
+            log.warning(
+                "standby: batch at %d corrupt past byte %d; kept the "
+                "clean prefix, resetting the link", msg.offset, clean,
+            )
+            self._server.reject_conn(conn_id)
+            self._primary_conn = None
+            return
+        self._server.write(conn_id, encode_msg(SyncAck(self.size)))
+        self.stats["acks_sent"] += 1
+
+    # -- takeover --------------------------------------------------------
+
+    async def promote(self, **coordinator_kwargs):
+        """Fenced takeover: stop following, fence the dead primary's
+        lineage, and return a live :class:`Coordinator` serving on this
+        standby's port. Replay-free — the shadow state applied record
+        by record as batches arrived IS the recovered state; the local
+        WAL is adopted append-only with the fenced epoch's boot record
+        (``Journal.adopt``)."""
+        from tpuminter.coordinator import Coordinator
+
+        if self.promoted:
+            raise RuntimeError("already promoted")
+        if not self._apply_shadow:
+            raise RuntimeError(
+                "a sink standby (apply_shadow=False) holds no shadow "
+                "state and cannot promote"
+            )
+        self.promoted = True
+        if (
+            self._run_task is not None
+            and self._run_task is not asyncio.current_task()
+        ):
+            self._run_task.cancel()
+            await asyncio.gather(self._run_task, return_exceptions=True)
+        if self._primary_conn is not None:
+            self._server.reject_conn(self._primary_conn)
+            self._primary_conn = None
+        epoch = max(self.shadow.boot_epoch, self.primary_epoch) + FENCE_JUMP
+        # local copy becomes the new coordinator's WAL: fsync what the
+        # follow loop wrote lazily, then adopt (no rescan)
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self._fh.close()
+        self._fh = None
+        journal = Journal.adopt(self.path, epoch)
+        self._server.set_boot_epoch(epoch)
+        coord = Coordinator(
+            self._server, journal=journal, **coordinator_kwargs
+        )
+        coord.adopt_recovered(self.shadow)
+        log.info(
+            "standby PROMOTED: epoch %d (fenced %d + %d), %d jobs and "
+            "%d winners live, port %d",
+            epoch, self.primary_epoch, FENCE_JUMP,
+            len(self.shadow.jobs), len(self.shadow.winners), self.port,
+        )
+        return coord
+
+    async def close(self) -> None:
+        """Tear down an un-promoted standby (a promoted one's server and
+        journal belong to the coordinator)."""
+        if self._run_task is not None and not self._run_task.done():
+            self._run_task.cancel()
+            await asyncio.gather(self._run_task, return_exceptions=True)
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        if not self.promoted and self._server is not None:
+            await self._server.close(drain_timeout=0.2)
+
+
+def gate_any(
+    primaries: List[ReplicationPrimary], target: int,
+    cb: Callable[[], None],
+) -> None:
+    """Replica-acked durability: fire ``cb`` once ANY standby has acked
+    past ``target`` bytes (first ack wins; duplicates are swallowed).
+    With no synced standby at all the callback fires immediately —
+    availability over replica durability, logged by the lane that lost
+    its session."""
+    fired = [False]
+
+    def once() -> None:
+        if not fired[0]:
+            fired[0] = True
+            cb()
+
+    gated = False
+    for p in primaries:
+        if p.gate(target, once):
+            gated = True
+    if not gated:
+        once()
+
+
+# ---------------------------------------------------------------------------
+# CLI: the standby / takeover role
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[list] = None) -> None:
+    """``python -m tpuminter.replication <primary-host:port> --wal W
+    --port P [--promote-after S]`` — follow the primary's WAL; once it
+    has been silent past the promote threshold, become the coordinator
+    (fenced epoch) on ``--port``."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="tpuminter hot-standby coordinator (WAL shipping target)"
+    )
+    parser.add_argument(
+        "primary", help="primary coordinator address, host:port",
+    )
+    parser.add_argument(
+        "--wal", required=True, metavar="PATH",
+        help="local WAL copy (also the promoted coordinator's journal)",
+    )
+    parser.add_argument(
+        "--port", type=int, default=0,
+        help="listen port — the address miners/clients list after the "
+        "primary's (0 = ephemeral, logged at startup)",
+    )
+    parser.add_argument(
+        "--promote-after", type=float, default=None, metavar="SECONDS",
+        help="auto-promote once the primary has been lost for this "
+        "long (default: follow forever; promotion is an operator "
+        "decision)",
+    )
+    args = parser.parse_args(argv)
+    host, _, port = args.primary.rpartition(":")
+    logging.basicConfig(level=logging.INFO)
+
+    async def _run() -> None:
+        standby = await ReplicationStandby.create(
+            args.wal, port=args.port
+        )
+        log.info(
+            "standby listening on port %d, following %s",
+            standby.port, args.primary,
+        )
+        # the primary dials US (push model) in production too: this
+        # role only listens. Wait for loss; maybe promote.
+        runner = asyncio.ensure_future(standby.run())
+        try:
+            if args.promote_after is None:
+                await runner
+                return
+            while True:
+                if standby._primary_conn is not None:
+                    await standby.primary_lost.wait()
+                # a primary that never (re)connects within the window is
+                # as dead as one that vanished mid-stream — a restarted
+                # standby holding a valid WAL copy must still take over
+                # when the primary machine is already gone
+                try:
+                    await asyncio.wait_for(
+                        _wait_primary_back(standby), args.promote_after
+                    )
+                    continue  # primary (re)connected in time
+                except asyncio.TimeoutError:
+                    pass
+                break
+            coord = await standby.promote()
+            log.info("serving as coordinator on port %d", coord.port)
+            await coord.serve()
+        finally:
+            runner.cancel()
+            await asyncio.gather(runner, return_exceptions=True)
+
+    asyncio.run(_run())
+
+
+async def _wait_primary_back(standby: ReplicationStandby) -> None:
+    while standby._primary_conn is None:
+        await asyncio.sleep(0.05)
+    standby.primary_lost.clear()
+
+
+if __name__ == "__main__":
+    main()
