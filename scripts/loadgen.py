@@ -110,6 +110,100 @@ from tpuminter.protocol import (  # noqa: E402
 )
 
 
+async def make_coordinator(
+    port: int = 0, *, loops: int = 1, io_batch=None,
+    journal_mode: str = "writer", recover_from=None,
+    threaded: bool = False, **kwargs
+):
+    """The one place the harness constructs a coordinator: ``loops >= 2``
+    builds the multi-loop sharded group (``tpuminter.multiloop``) — and
+    FAILS LOUDLY if it cannot (no silent single-loop fallback: a smoke
+    gate that asked for 2 loops must never accidentally measure 1).
+    ``threaded=True`` with ``loops=1`` runs the ONE shard off the
+    caller's loop too — the A/B baseline that isolates the partitioning
+    seam from the cost of the coordinator simply not sharing the
+    drivers' loop (PERF.md §Round 11)."""
+    if loops <= 1 and not threaded:
+        return await Coordinator.create(
+            port, io_batch=io_batch, recover_from=recover_from, **kwargs
+        )
+    from tpuminter.multiloop import MultiLoopCoordinator
+
+    return await MultiLoopCoordinator.create(
+        port, loops=loops, io_batch=io_batch, journal_mode=journal_mode,
+        recover_from=recover_from, **kwargs
+    )
+
+
+def _servers(coord) -> list:
+    return list(coord.servers) if hasattr(coord, "servers") else [
+        coord.server
+    ]
+
+
+def _endpoints(coord) -> list:
+    return [srv.endpoint for srv in _servers(coord)]
+
+
+def _ep_totals(coord) -> tuple:
+    """(sent, received, bytes) summed over every shard socket."""
+    eps = _endpoints(coord)
+    return (
+        sum(ep.sent for ep in eps),
+        sum(ep.received for ep in eps),
+        sum(ep.sent_bytes + ep.received_bytes for ep in eps),
+    )
+
+
+def _ack_totals(coord) -> dict:
+    out = {"acks_sent": 0, "acks_coalesced": 0}
+    for srv in _servers(coord):
+        st = srv.ack_stats()
+        out["acks_sent"] += st.get("acks_sent", 0)
+        out["acks_coalesced"] += st.get("acks_coalesced", 0)
+    return out
+
+
+def _hook_lost_events(coord, counter: dict) -> None:
+    """Count loss events at every shard's server seam."""
+    for srv in _servers(coord):
+        orig = srv._handle_lost
+
+        def counting(conn_id: int, _orig=orig) -> None:
+            counter["n"] += 1
+            _orig(conn_id)
+
+        srv._handle_lost = counting
+
+
+def _lat_baseline(coord):
+    if hasattr(coord, "shards"):
+        return [len(sh.coordinator.latencies) for sh in coord.shards]
+    return len(coord.latencies)
+
+
+def _lat_new(coord, baseline) -> list:
+    if hasattr(coord, "shards"):
+        return [
+            x
+            for sh, b in zip(coord.shards, baseline)
+            for x in list(sh.coordinator.latencies)[b:]
+        ]
+    return list(coord.latencies)[baseline:]
+
+
+async def _crash_coordinator(coord) -> None:
+    """kill -9 either coordinator shape and wait until its socket(s)
+    actually released the port (a real kill -9 has the OS do this at
+    process exit, before any restart could bind)."""
+    res = coord.crash()
+    if asyncio.iscoroutine(res):
+        await res  # multiloop: joins the shard threads, port is free
+        return
+    for ep in _endpoints(coord):
+        await ep.wait_closed()
+
+
 async def _instant_miner(
     port: int, params: Params, *, binary: bool = True,
     idle_gaps: Optional[list] = None, delay: float = 0.0,
@@ -239,8 +333,12 @@ async def _client_loop(port: int, params: Params, cid: int, upper: int,
                        counter: dict) -> None:
     """Closed-loop client: submit a MIN job, await its Result, repeat —
     one LSP connection for the whole run (the reference's one-shot
-    connect/submit would measure dial latency, not the scheduler)."""
+    connect/submit would measure dial latency, not the scheduler).
+    Every answered job id is remembered so a SECOND answer for it is
+    booked in ``counter['dup_answers']`` — the cross-shard duplication
+    evidence the multi-loop smoke gate asserts zero of."""
     c = await LspClient.connect("127.0.0.1", port, params)
+    answered: set = set()
     try:
         jid = 0
         while True:
@@ -251,7 +349,13 @@ async def _client_loop(port: int, params: Params, cid: int, upper: int,
             )))
             while True:
                 msg = decode_msg(await c.read())
-                if isinstance(msg, Result) and msg.job_id == jid:
+                if not isinstance(msg, Result):
+                    continue
+                if msg.job_id in answered:
+                    counter["dup_answers"] += 1
+                    continue
+                if msg.job_id == jid:
+                    answered.add(msg.job_id)
                     break
             counter["jobs"] += 1
     except (LspConnectionLost, asyncio.CancelledError):
@@ -289,6 +393,11 @@ async def run_load(
     standby_sink: bool = False,
     replica_ack: bool = False,
     miner_delay: float = 0.0,
+    loops: int = 1,
+    io_batch=None,
+    journal_mode: str = "writer",
+    journal_group_commit: Optional[bool] = None,
+    threaded: bool = False,
 ) -> dict:
     """Drive the fleet for ``duration`` seconds (after ``warmup``) and
     return the metrics dict described in the module docstring.
@@ -321,12 +430,19 @@ async def run_load(
         )
         stby_task = asyncio.ensure_future(stby.run())
         replicate_to = [("127.0.0.1", stby.port)]
-    coord = await Coordinator.create(
+    coord = await make_coordinator(
         params=params, chunk_size=chunk_size, recover_from=journal_path,
         binary_codec=binary, pipeline_depth=pipeline_depth,
         journal_tick_flush=journal_tick_flush,
         replicate_to=replicate_to, replica_ack=replica_ack,
+        loops=loops, io_batch=io_batch, journal_mode=journal_mode,
+        threaded=threaded,
     )
+    if journal_group_commit is not None and coord._journal is not None:
+        # cross-job group-commit A/B knob (PERF.md §Round 11): False
+        # restores the fsync-per-batch PR 3–5 behavior
+        for j in getattr(coord._journal, "_journals", [coord._journal]):
+            j.group_commit = journal_group_commit
     serve = asyncio.ensure_future(coord.serve())
     # jobs long enough that every miner stays busy between completions
     if chunks_per_job is None:
@@ -335,13 +451,7 @@ async def run_load(
     lost_events = {"n": 0}
     # count loss events at the server seam: a healthy loopback run must
     # declare nobody dead (a stalled loop shows up here first)
-    orig_handle_lost = coord._server._handle_lost
-
-    def counting_handle_lost(conn_id: int) -> None:
-        lost_events["n"] += 1
-        orig_handle_lost(conn_id)
-
-    coord._server._handle_lost = counting_handle_lost
+    _hook_lost_events(coord, lost_events)
 
     idle_gaps: list = []
     miners = [
@@ -351,7 +461,7 @@ async def run_load(
         ))
         for _ in range(n_miners)
     ]
-    counter = {"jobs": 0}
+    counter = {"jobs": 0, "dup_answers": 0}
     clients = [
         asyncio.ensure_future(
             _client_loop(coord.port, params, i, upper, counter)
@@ -381,41 +491,45 @@ async def run_load(
     depth_task = asyncio.ensure_future(depth_sampler())
     try:
         await asyncio.sleep(warmup)
-        ep = coord.server.endpoint
         t0 = time.monotonic()
         chunks0 = coord._next_chunk_id
         # churn-proof cumulative counters (per-miner sums would lose a
         # lost miner's whole history from the delta)
+        stats0 = coord.stats
         results0 = (
-            coord.stats["results_accepted"] + coord.stats["results_rejected"]
+            stats0["results_accepted"] + stats0["results_rejected"]
         )
-        rejected0 = coord.stats["results_rejected"]
-        pipelined0 = coord.stats["dispatches_pipelined"]
-        lat_seen0 = len(coord.latencies)
-        sent0, recv0 = ep.sent, ep.received
-        bytes0 = ep.sent_bytes + ep.received_bytes
+        rejected0 = stats0["results_rejected"]
+        pipelined0 = stats0["dispatches_pipelined"]
+        lat_seen0 = _lat_baseline(coord)
+        sent0, recv0, bytes0 = _ep_totals(coord)
         codec0 = dict(codec_stats)
         jobs0 = counter["jobs"]
+        dups0 = counter["dup_answers"]
         stall["max_stall"] = 0.0  # warmup stalls (connect burst) excluded
         depth_samples.clear()
         idle_gaps.clear()
         await asyncio.sleep(duration)
         dt = time.monotonic() - t0
         assigns = coord._next_chunk_id - chunks0
+        stats1 = coord.stats
         results = (
-            coord.stats["results_accepted"] + coord.stats["results_rejected"]
+            stats1["results_accepted"] + stats1["results_rejected"]
             - results0
         )
-        lats = list(coord.latencies)[lat_seen0:] or [0.0]
+        lats = _lat_new(coord, lat_seen0) or [0.0]
         lats_ms = sorted(1e3 * x for x in lats)
-        ack_stats = getattr(coord.server, "ack_stats", lambda: {})()
+        ack_stats = _ack_totals(coord)
         gaps_ms = sorted(1e3 * g for g in idle_gaps) or [0.0]
-        wire_bytes = ep.sent_bytes + ep.received_bytes - bytes0
+        sent1, recv1, bytes1 = _ep_totals(coord)
+        wire_bytes = bytes1 - bytes0
         return {
             "fleet": n_miners,
             "clients": n_clients,
             "duration_s": round(dt, 3),
             "codec": "binary" if binary else "json",
+            "loops": getattr(coord, "loops", 1),
+            "io_batch": _endpoints(coord)[0].sock is not None,
             "pipeline_depth_configured": pipeline_depth,
             "results_per_s": round(results / dt, 1),
             "assigns_per_s": round(assigns / dt, 1),
@@ -425,12 +539,13 @@ async def run_load(
                 lats_ms[max(0, int(len(lats_ms) * 0.99) - 1)], 3
             ),
             "max_stall_ms": round(stall["max_stall"] * 1e3, 3),
-            "frames_sent": ep.sent - sent0,
-            "frames_received": ep.received - recv0,
+            "frames_sent": sent1 - sent0,
+            "frames_received": recv1 - recv0,
             "acks_sent": ack_stats.get("acks_sent", 0),
             "acks_coalesced": ack_stats.get("acks_coalesced", 0),
             "miners_lost": lost_events["n"],
-            "results_rejected": coord.stats["results_rejected"] - rejected0,
+            "dup_answers": counter["dup_answers"] - dups0,
+            "results_rejected": stats1["results_rejected"] - rejected0,
             # -- codec accounting (satellite: the 16%-JSON-codec claim
             #    stays re-checkable from a shipped JSON). Message counts
             #    are process-wide (both ends run in this process, so an
@@ -450,7 +565,7 @@ async def run_load(
             #    outstanding, the sampled fill level, and the
             #    result→next-assign bubble at the miners
             "dispatches_pipelined": (
-                coord.stats["dispatches_pipelined"] - pipelined0
+                stats1["dispatches_pipelined"] - pipelined0
             ),
             "pipeline_depth_mean": round(
                 statistics.mean(s[0] for s in depth_samples), 2
@@ -461,6 +576,19 @@ async def run_load(
             "miner_idle_gap_p50_ms": round(statistics.median(gaps_ms), 3),
             "miner_idle_gap_p99_ms": round(
                 gaps_ms[max(0, int(len(gaps_ms) * 0.99) - 1)], 3
+            ),
+            # -- per-loop balance (the multi-loop satellite): results,
+            #    datagrams, connections, handoffs, and stall per shard
+            **(
+                {
+                    "steer_kernel": coord.steer_kernel,
+                    "loop_metrics": coord.shard_metrics(),
+                }
+                if hasattr(coord, "shard_metrics") else {}
+            ),
+            **(
+                {"journal": dict(coord._journal.stats)}
+                if coord._journal is not None else {}
             ),
             **(
                 {
@@ -525,6 +653,30 @@ def smoke_check(metrics: dict, params: Params = FAST) -> list:
         )
     if metrics.get("codec") == "binary" and metrics.get("msgs_binary", 0) <= 0:
         bad.append("binary codec configured but no binary messages flowed")
+    # multi-loop gates (ISSUE 6 satellite): answers must never duplicate
+    # across shards, and with a fleet large enough that an empty shard
+    # is statistically impossible, every loop must actually carry peers
+    if metrics.get("dup_answers", 0) > 0:
+        bad.append(
+            f"{metrics['dup_answers']} duplicate answer(s) reached a "
+            f"client — cross-shard answer duplication"
+        )
+    loops = metrics.get("loops", 1)
+    if loops > 1:
+        shards = metrics.get("loop_metrics", [])
+        if len(shards) != loops:
+            bad.append(
+                f"{loops} loops requested but {len(shards)} reported — "
+                f"a silent single-loop fallback"
+            )
+        elif metrics.get("fleet", 0) >= 8 * loops and any(
+            s["conns"] == 0 and s["handoff_in"] == 0 for s in shards
+        ):
+            bad.append(
+                f"a shard carried no connections at fleet "
+                f"{metrics['fleet']}: partitioning is not spreading "
+                f"({shards})"
+            )
     return bad
 
 
@@ -619,6 +771,9 @@ async def run_crash(
     drain: float = 10.0,
     binary: bool = True,
     pipeline_depth: int = 2,
+    loops: int = 1,
+    io_batch=None,
+    journal_mode: str = "writer",
 ) -> dict:
     """The crash-recovery drill: journaled coordinator + resilient
     fleet; kill the coordinator mid-burst (socket closed, no drain,
@@ -636,9 +791,10 @@ async def run_crash(
     if journal_path is None:
         tmpdir = tempfile.mkdtemp(prefix="tpuminter-loadgen-")
         journal_path = os.path.join(tmpdir, "coordinator.wal")
-    coord = await Coordinator.create(
+    coord = await make_coordinator(
         params=params, chunk_size=chunk_size, recover_from=journal_path,
         binary_codec=binary, pipeline_depth=pipeline_depth,
+        loops=loops, io_batch=io_batch, journal_mode=journal_mode,
     )
     port = coord.port
     serve = asyncio.ensure_future(coord.serve())
@@ -676,7 +832,7 @@ async def run_crash(
     sample_task = asyncio.ensure_future(sampler())
     metrics: dict = {
         "fleet": n_miners, "clients": n_clients,
-        "chunk_size": chunk_size,
+        "chunk_size": chunk_size, "loops": loops,
     }
     try:
         await asyncio.sleep(pre)
@@ -686,21 +842,20 @@ async def run_crash(
         state["coord"] = None
         serve.cancel()
         await asyncio.gather(serve, return_exceptions=True)
-        old_endpoint = coord.server.endpoint
-        coord.crash()
-        # the asyncio transport releases the port a loop tick later; a
-        # real kill -9 has the OS do this at process exit, before any
-        # restart could bind — wait it out, then bind the same port
-        await old_endpoint.wait_closed()
+        # a real kill -9 has the OS release the port at process exit,
+        # before any restart could bind — wait it out, then bind it
+        await _crash_coordinator(coord)
         pre_results = state["carried"]
         # -- restart from the journal on the same port -------------------
         t_restart0 = time.monotonic()
         for attempt in range(50):
             try:
-                coord = await Coordinator.create(
+                coord = await make_coordinator(
                     port, params=params, chunk_size=chunk_size,
                     recover_from=journal_path,
                     binary_codec=binary, pipeline_depth=pipeline_depth,
+                    loops=loops, io_batch=io_batch,
+                    journal_mode=journal_mode,
                 )
                 break
             except OSError:
@@ -817,6 +972,8 @@ async def run_failover(
     binary: bool = True,
     pipeline_depth: int = 2,
     replica_ack: bool = True,
+    loops: int = 1,
+    io_batch=None,
 ) -> dict:
     """The replicated-coordinator drill: primary journals AND ships its
     WAL to a live hot standby; mid-burst the primary machine "dies"
@@ -842,11 +999,12 @@ async def run_failover(
     standby_wal = os.path.join(tmpdir, "standby.wal")
     standby = await ReplicationStandby.create(standby_wal, params=params)
     standby_task = asyncio.ensure_future(standby.run())
-    coord = await Coordinator.create(
+    coord = await make_coordinator(
         params=params, chunk_size=chunk_size, recover_from=primary_wal,
         binary_codec=binary, pipeline_depth=pipeline_depth,
         replicate_to=[("127.0.0.1", standby.port)],
         replica_ack=replica_ack,
+        loops=loops, io_batch=io_batch,
     )
     ports = [coord.port, standby.port]
     serve = asyncio.ensure_future(coord.serve())
@@ -885,6 +1043,7 @@ async def run_failover(
     metrics: dict = {
         "fleet": n_miners, "clients": n_clients,
         "chunk_size": chunk_size, "replica_ack": replica_ack,
+        "loops": loops,
     }
     coord2 = None
     serve2 = None
@@ -905,7 +1064,7 @@ async def run_failover(
         state["coord"] = None
         serve.cancel()
         await asyncio.gather(serve, return_exceptions=True)
-        coord.crash()
+        await _crash_coordinator(coord)
         pre_results = state["carried"]
         # -- the standby notices on its own (loss horizon) ---------------
         await asyncio.wait_for(
@@ -1087,18 +1246,52 @@ def main(argv=None) -> int:
         "negotiated via Join; json = the PR 3 baseline for A/B runs)",
     )
     parser.add_argument(
+        "--loops", type=int, default=1, metavar="N",
+        help="event loops the coordinator shards across (SO_REUSEPORT "
+        "multi-loop, tpuminter.multiloop; 1 = the classic single-loop "
+        "coordinator). Requesting N > 1 on a host that cannot shard "
+        "FAILS — never a silent single-loop fallback",
+    )
+    parser.add_argument(
+        "--io-batch", choices=("on", "off"), default="on",
+        help="batched socket I/O: 'on' drains a bounded recvfrom burst "
+        "per epoll wakeup and groups each tick's sends (default); "
+        "'off' restores the stdlib asyncio datagram transport — the "
+        "PERF.md Round 11 A/B baseline",
+    )
+    parser.add_argument(
+        "--journal-mode", choices=("writer", "segments"), default="writer",
+        help="multi-loop journal seam: 'writer' = one WAL on the "
+        "writer loop fed by per-shard queues (default; required for "
+        "replication), 'segments' = one WAL file per loop, merged at "
+        "recovery (cannot ship to a standby)",
+    )
+    parser.add_argument(
         "--pipeline", type=int, default=2, metavar="N",
         help="chunks kept outstanding per miner (2 = shipping default; "
         "1 = the PR 3 round-trip-per-chunk baseline for A/B runs)",
+    )
+    parser.add_argument(
+        "--group-commit", choices=("on", "off"), default="off",
+        help="cross-job group commit of winner fsyncs (journal runs "
+        "only). Default off — measured a LOSS on this fast-fsync "
+        "host (the window's latency costs closed-loop clients more "
+        "than the saved fsyncs are worth, PERF.md Round 11); 'on' is "
+        "the knob for slow-disk deployments and A/B runs",
     )
     parser.add_argument("--json", action="store_true", help="JSON output")
     args = parser.parse_args(argv)
     knobs = dict(
         binary=args.codec == "binary", pipeline_depth=args.pipeline,
+        loops=args.loops, io_batch=args.io_batch == "on",
     )
     if args.scenario == "failover":
         if args.smoke:
-            args.miners = min(args.miners, 8)
+            # 2+ loops need a fleet big enough that an empty shard is
+            # statistically impossible (hash partition, see smoke_check)
+            args.miners = min(args.miners, 8) if args.loops <= 1 else max(
+                args.miners, 8 * args.loops
+            )
             args.duration = min(args.duration, 2.0)
         metrics = asyncio.run(run_failover(
             args.miners, max(2, args.clients // 2),
@@ -1113,10 +1306,13 @@ def main(argv=None) -> int:
             print(f"FAILOVER FAIL: {v}", file=sys.stderr)
         return 1 if violations else 0
     if args.scenario == "crash":
+        if args.smoke and args.loops > 1:
+            args.miners = max(args.miners, 8 * args.loops)
         metrics = asyncio.run(run_crash(
             args.miners, max(2, args.clients // 2),
             journal_path=args.journal, chunk_size=args.chunk_size,
-            pre=min(args.duration, 2.0), post=args.duration, **knobs,
+            pre=min(args.duration, 2.0), post=args.duration,
+            journal_mode=args.journal_mode, **knobs,
         ))
         print(json.dumps(metrics) if args.json else
               "\n".join(f"{k}: {v}" for k, v in metrics.items()))
@@ -1132,7 +1328,9 @@ def main(argv=None) -> int:
         chunk_size=args.chunk_size, journal_path=args.journal,
         journal_tick_flush=args.journal_flush == "tick",
         standby=args.standby, replica_ack=args.replica_ack,
-        miner_delay=args.miner_delay, **knobs,
+        miner_delay=args.miner_delay, journal_mode=args.journal_mode,
+        journal_group_commit=args.group_commit == "on",
+        **knobs,
     ))
     print(json.dumps(metrics) if args.json else
           "\n".join(f"{k}: {v}" for k, v in metrics.items()))

@@ -1,0 +1,424 @@
+"""Multi-loop sharded coordinator (ISSUE 6): the partition function's
+properties, per-loop WAL segment reassembly, kernel/userspace steering,
+batched socket I/O semantics, and the 2-loop smoke/crash/failover gates.
+
+The partition tests are pure and sub-second; the drills are the tier-1
+gates the issue names — zero lost connections, zero cross-shard answer
+duplication, and exactly-once ledgers through kill -9 and machine-loss
+failover with ``--loops 2``.
+"""
+
+import asyncio
+import os
+import random
+import sys
+
+import pytest
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+    ),
+)
+
+import loadgen  # noqa: E402  (scripts/ is not a package)
+
+from tpuminter.journal import (  # noqa: E402
+    Journal,
+    RecoveredState,
+    encode_settle,
+    intersect_ranges,
+    merge_states,
+    replay,
+)
+from tpuminter.lsp import LspServer  # noqa: E402
+from tpuminter.lsp.params import FAST  # noqa: E402
+from tpuminter.lsp.transport import UdpEndpoint  # noqa: E402
+from tpuminter.multiloop import (  # noqa: E402
+    MultiLoopCoordinator,
+    attach_conn_steering,
+    shard_for_job,
+    shard_of,
+)
+from tpuminter.protocol import request_to_obj, Request, PowMode  # noqa: E402
+
+from tests.test_e2e import run  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# the partition function (pure properties)
+# ---------------------------------------------------------------------------
+
+def test_shard_of_is_stable_across_reconnects_epochs_and_order():
+    """The assignment is a pure function of the address: evaluation
+    order, repetition, interleaving with other peers, and any notion of
+    'epoch' cannot move a peer to a different shard."""
+    rng = random.Random(0xC0FFEE)
+    addrs = [
+        ("127.0.0.1", rng.randrange(1024, 65536)) for _ in range(256)
+    ] + [("10.%d.%d.%d" % (rng.randrange(256), rng.randrange(256),
+                           rng.randrange(256)), rng.randrange(1024, 65536))
+         for _ in range(256)]
+    for loops in (2, 3, 4, 8):
+        first = {a: shard_of(a, loops) for a in addrs}
+        assert all(0 <= s < loops for s in first.values())
+        # re-evaluate in shuffled order, many times over ("epochs")
+        for _ in range(3):
+            shuffled = list(addrs)
+            rng.shuffle(shuffled)
+            for a in shuffled:
+                assert shard_of(a, loops) == first[a]
+
+
+def test_shard_of_balances_random_peer_sets():
+    """Binomial balance bound: over 512 uniform-random peers and 2
+    shards, each side holds at least 35% (P[violation] ~ 1e-11 for a
+    uniform hash — a failure here means the hash is broken, not
+    unlucky). Looser per-shard floor for 4 shards."""
+    rng = random.Random(1234)
+    addrs = [
+        ("127.0.0.1", rng.randrange(1024, 65536)) for _ in range(512)
+    ]
+    counts2 = [0, 0]
+    for a in addrs:
+        counts2[shard_of(a, 2)] += 1
+    assert min(counts2) >= int(0.35 * len(addrs)), counts2
+    counts4 = [0] * 4
+    for a in addrs:
+        counts4[shard_of(a, 4)] += 1
+    assert min(counts4) >= int(0.12 * len(addrs)), counts4
+
+
+def test_shard_for_job_matches_the_id_stripe():
+    """Shard k allocates job ids ≡ k+1 (mod N) (Coordinator
+    job_id_start/stride); shard_for_job must invert that exactly, so
+    recovered jobs land back on the lane that minted them."""
+    for loops in (2, 3, 5):
+        for k in range(loops):
+            ids = [k + 1 + i * loops for i in range(16)]
+            assert all(shard_for_job(j, loops) == k for j in ids)
+    # single loop degenerates to shard 0
+    assert shard_for_job(12345, 1) == 0
+
+
+def test_conn_id_stride_partitions_the_id_space():
+    """A shard's LspServer allocates conn ids in its own residue class
+    — the invariant the kernel's conn-id steering program relies on."""
+
+    async def scenario():
+        server = await LspServer.create(
+            0, FAST, conn_id_start=3, conn_id_stride=4
+        )
+        try:
+            ids = [
+                server._new_conn(("127.0.0.1", 40000 + i)).conn_id
+                for i in range(5)
+            ]
+            assert ids == [3, 7, 11, 15, 19]
+        finally:
+            await server.close(drain_timeout=0.2)
+
+    run(scenario())
+
+
+def test_attach_conn_steering_on_this_kernel():
+    """The cBPF steering program must attach on Linux (this container's
+    kernel accepted it during development — a regression here silently
+    demotes every multi-loop run to the forwarding shim)."""
+    import socket as s
+
+    sock = s.socket(s.AF_INET, s.SOCK_DGRAM)
+    try:
+        sock.setsockopt(s.SOL_SOCKET, s.SO_REUSEPORT, 1)
+        sock.bind(("127.0.0.1", 0))
+        attached = attach_conn_steering(sock, 2)
+    finally:
+        sock.close()
+    if sys.platform.startswith("linux"):
+        assert attached
+    else:
+        assert not attached
+
+
+# ---------------------------------------------------------------------------
+# per-loop WAL segments reassemble into the single-journal state
+# ---------------------------------------------------------------------------
+
+def _req(jid: int) -> dict:
+    return request_to_obj(Request(
+        job_id=jid, mode=PowMode.MIN, lower=0, upper=4095,
+        data=b"seg-%d" % jid, client_key=f"ck-{jid}",
+    ))
+
+
+def _records_for(jid: int) -> list:
+    """One job's full record stream (job → settles → finish/abandon)."""
+    recs = [{"k": "job", "id": jid, "req": _req(jid)}]
+    recs.append({"k": "settle", "id": jid, "lo": 0, "hi": 1023,
+                 "n": 7, "s": 1024, "h": "%x" % (1000 + jid)})
+    recs.append({"k": "settle", "id": jid, "lo": 2048, "hi": 3071,
+                 "n": 9, "s": 1024, "h": "%x" % (900 + jid)})
+    if jid % 3 == 0:
+        recs.append({
+            "k": "finish", "id": jid, "ckey": f"ck-{jid}", "cjid": jid,
+            "mode": "min", "n": 9, "h": "%x" % (900 + jid),
+            "found": True, "s": 2048,
+        })
+    return recs
+
+
+def _assert_states_equal(a: RecoveredState, b: RecoveredState) -> None:
+    assert a.next_job_id == b.next_job_id
+    assert set(a.jobs) == set(b.jobs)
+    for jid in a.jobs:
+        ja, jb = a.jobs[jid], b.jobs[jid]
+        assert ja.remaining == jb.remaining, jid
+        assert ja.best == jb.best
+        assert ja.hashes_done == jb.hashes_done
+        assert request_to_obj(ja.request) == request_to_obj(jb.request)
+    assert dict(a.winners) == dict(b.winners)
+
+
+def test_segment_merge_reassembles_the_single_journal_state():
+    """The ISSUE 6 regression: records split across per-loop WAL
+    segments by job affinity — including a segment that compacted
+    itself into a snapshot mid-stream — must merge back into EXACTLY
+    the state a single interleaved journal replays to."""
+    loops = 2
+    all_jobs = list(range(1, 9))
+    # the single-journal ground truth: records interleaved across jobs
+    single: list = []
+    per_shard: dict = {0: [], 1: []}
+    for jid in all_jobs:
+        recs = _records_for(jid)
+        single.extend(recs)
+        per_shard[shard_for_job(jid, loops)].extend(recs)
+    truth = replay(single)
+
+    # plain split
+    merged = merge_states([replay(per_shard[0]), replay(per_shard[1])])
+    _assert_states_equal(truth, merged)
+
+    # shard 0 compacts itself mid-stream: snapshot of its own replayed
+    # prefix + the tail — a snapshot record must reset only ITS stream
+    half = len(per_shard[0]) // 2
+    st0 = replay(per_shard[0][:half])
+    seg0_compacted = [st0.snapshot_obj()] + per_shard[0][half:]
+    merged2 = merge_states([
+        replay(seg0_compacted), replay(per_shard[1])
+    ])
+    _assert_states_equal(truth, merged2)
+
+
+def test_intersect_ranges():
+    assert intersect_ranges([(0, 10)], [(5, 20)]) == [(5, 10)]
+    assert intersect_ranges([(0, 3), (8, 12)], [(2, 9)]) == [
+        (2, 3), (8, 9)
+    ]
+    assert intersect_ranges([(0, 3)], [(4, 9)]) == []
+    assert intersect_ranges([], [(0, 5)]) == []
+
+
+def test_journal_open_absorbs_segments(tmp_path):
+    """A single-loop restart over a segmented journal layout merges the
+    segments, snapshots them into the base WAL, and deletes them —
+    crossing loop counts/modes never loses coverage."""
+    base = str(tmp_path / "w.wal")
+    for k in (0, 1):
+        j = Journal.fresh(f"{base}.s{k}", epoch=3)
+        for jid in (k + 1, k + 3):
+            for rec in _records_for(jid):
+                if rec["k"] == "settle":
+                    j.append_encoded(encode_settle(
+                        rec["id"], rec["lo"], rec["hi"], rec["n"],
+                        rec["s"], int(rec["h"], 16),
+                    ))
+                else:
+                    j.append(rec["k"], rec)
+        j._flush_buffered_sync()
+        j._fh.close()
+    journal, state = Journal.open(base)
+    try:
+        assert state.boot_epoch == 4
+        # job 3 finished (jid % 3 == 0): in winners, not in jobs
+        assert set(state.jobs) == {1, 2, 4}
+        assert ("ck-3", 3) in state.winners
+        assert not os.path.exists(f"{base}.s0")
+        assert not os.path.exists(f"{base}.s1")
+    finally:
+        journal._fh.close()
+    # a SECOND open replays the absorbed snapshot identically
+    journal2, state2 = Journal.open(base)
+    journal2._fh.close()
+    _assert_states_equal(state, state2)
+
+
+# ---------------------------------------------------------------------------
+# cross-job group commit of finish fsyncs
+# ---------------------------------------------------------------------------
+
+def test_group_commit_shares_one_fsync_across_a_winner_burst(tmp_path):
+    """Six winner-gating records arriving within the group-commit
+    window must share far fewer fsyncs than one each — and every
+    durability callback still fires."""
+
+    async def scenario():
+        journal, _ = Journal.open(str(tmp_path / "g.wal"))
+        journal.group_commit = True  # measured-off default; see journal.py
+        fired = []
+        base_syncs = journal.stats["syncs"]  # the boot record's fsync
+        for i in range(6):
+            journal.append(
+                "finish",
+                {"id": i, "ckey": f"c{i}", "cjid": i, "mode": "min",
+                 "n": 1, "h": "ff", "found": True, "s": 1},
+                on_durable=lambda i=i: fired.append(i),
+            )
+            await asyncio.sleep(0.0005)
+        await journal.flush()
+        assert sorted(fired) == list(range(6))
+        extra_syncs = journal.stats["syncs"] - base_syncs
+        assert 1 <= extra_syncs <= 3, extra_syncs
+        await journal.aclose()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# batched socket I/O: fault injection + grouped sends are mode-identical
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("io_batch", [True, False])
+def test_endpoint_modes_deliver_and_inject_faults(io_batch):
+    """Both transport backends deliver datagrams, honor the seeded
+    drop-rate seam, and expose the same counters — the layers above
+    must not be able to tell them apart."""
+
+    async def scenario():
+        got = []
+        server = await UdpEndpoint.create(
+            lambda d, a: got.append(bytes(d)), local_addr=("127.0.0.1", 0),
+            io_batch=io_batch, seed=7,
+        )
+        sender = await UdpEndpoint.create(
+            lambda d, a: None, io_batch=io_batch, seed=7
+        )
+        try:
+            addr = server.local_addr
+            for i in range(40):
+                sender.send(b"m%d" % i, addr)
+            sender.send_batch([b"b1", b"b2", b"b3"], addr)
+            sender.send_grouped([(addr, [b"g1", b"g2"])])
+            await asyncio.sleep(0.2)
+            assert sorted(got) == sorted(
+                [b"m%d" % i for i in range(40)]
+                + [b"b1", b"b2", b"b3", b"g1", b"g2"]
+            )
+            assert sender.sent == 45
+            assert server.received == 45
+            # the read-drop seam still bites in this mode
+            server.set_read_drop_rate(1.0)
+            sender.send(b"dropped", addr)
+            await asyncio.sleep(0.1)
+            assert server.dropped_in >= 1
+            assert b"dropped" not in got
+        finally:
+            server.close()
+            sender.close()
+            await server.wait_closed()
+            await sender.wait_closed()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# construction constraints (loud fallbacks)
+# ---------------------------------------------------------------------------
+
+def test_multiloop_rejects_bad_configs(tmp_path):
+    async def scenario():
+        with pytest.raises(ValueError):
+            await MultiLoopCoordinator.create(loops=0)
+        with pytest.raises(ValueError):
+            await MultiLoopCoordinator.create(
+                loops=2, recover_from=str(tmp_path / "x.wal"),
+                journal_mode="segments",
+                replicate_to=[("127.0.0.1", 1)],
+            )
+        with pytest.raises(ValueError):
+            await MultiLoopCoordinator.create(
+                loops=2, replicate_to=[("127.0.0.1", 1)]
+            )
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# the 2-loop gates (ISSUE 6 acceptance)
+# ---------------------------------------------------------------------------
+
+def test_two_loop_smoke_no_losses_no_cross_shard_duplication():
+    """The tier-1 2-loop smoke gate: a fleet-16 burst across 2 loops
+    sustains with zero lost connections, zero duplicate answers, both
+    shards carrying peers, and the partitioning verifiably live."""
+    metrics = run(loadgen.run_load(16, 4, 1.2, loops=2), timeout=60.0)
+    assert loadgen.smoke_check(metrics) == [], metrics
+    assert metrics["loops"] == 2
+    assert metrics["dup_answers"] == 0
+    assert metrics["miners_lost"] == 0
+    shards = metrics["loop_metrics"]
+    assert len(shards) == 2
+    # every loop carries peers and traffic (20 peers over a uniform
+    # hash: an empty shard is ~2^-19 — a failure is a partitioning
+    # bug). Per-shard RESULTS are deliberately not asserted: 4 clients
+    # can legitimately all hash to one shard (~12% of runs), and a
+    # job mines on its client's shard — that is affinity working, not
+    # a bug. The balance evidence is connections, not results.
+    assert all(s["conns"] > 0 for s in shards), shards
+    assert all(s["datagrams_received"] > 0 for s in shards), shards
+    assert sum(s["results_accepted"] for s in shards) > 0, shards
+
+
+def test_two_loop_crash_drill_exactly_once():
+    """kill -9 a 2-loop coordinator mid-burst (single-writer journal),
+    restart it with 2 loops on the same port: every submitted request
+    answered exactly once."""
+    metrics = run(
+        loadgen.run_crash(16, 2, pre=1.0, post=2.0, loops=2),
+        timeout=120.0,
+    )
+    assert loadgen.crash_check(metrics) == [], metrics
+    assert metrics["answers_duplicated"] == 0
+    assert metrics["answers_lost"] == 0
+    assert metrics["loops"] == 2
+
+
+def test_two_loop_crash_drill_segments_mode():
+    """Same drill on per-loop WAL segments: recovery reassembles the
+    segments into one coherent state (the journal-seam alternative)."""
+    metrics = run(
+        loadgen.run_crash(
+            16, 2, pre=1.0, post=2.0, loops=2, journal_mode="segments"
+        ),
+        timeout=120.0,
+    )
+    assert loadgen.crash_check(metrics) == [], metrics
+    assert metrics["answers_duplicated"] == 0
+    assert metrics["answers_lost"] == 0
+
+
+def test_two_loop_failover_drill_exactly_once():
+    """Machine-loss failover of a SHARDED primary: the 2-loop
+    coordinator ships one coherent WAL stream; the standby promotes
+    fenced and the fleet lands — exactly-once across the loss."""
+    metrics = run(
+        loadgen.run_failover(16, 2, pre=1.2, post=2.0, loops=2),
+        timeout=120.0,
+    )
+    assert loadgen.failover_check(metrics) == [], metrics
+    assert metrics["answers_duplicated"] == 0
+    assert metrics["answers_lost"] == 0
+    assert metrics["loops"] == 2
+    assert metrics["replicated_records_pre_kill"] > 0

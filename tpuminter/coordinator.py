@@ -331,6 +331,9 @@ class Coordinator:
         journal_tick_flush: bool = True,
         replicate_to: Optional[List[Tuple[str, int]]] = None,
         replica_ack: bool = False,
+        job_id_start: int = 1,
+        job_id_stride: int = 1,
+        replica_gate=None,
     ):
         self._server = server
         self._chunk_size = chunk_size
@@ -377,12 +380,19 @@ class Coordinator:
                 )
                 for host, port in replicate_to
             ]
+        #: injected replica-ack router (tpuminter.multiloop): a sharded
+        #: coordinator's shipping lanes live on the writer loop, so a
+        #: non-writer shard gates its winner acks through this callable
+        #: instead of local lanes. Signature ``(target_offset, cb)``.
+        self._replica_gate = replica_gate
         #: replica-acked durability tier: winner acknowledgements wait
         #: for a standby SyncAck past the finish record on top of the
         #: local fsync (an answered winner then survives machine loss,
         #: not just process loss). Degrades loudly to local-only when
         #: no standby session is synced.
-        self._replica_ack = replica_ack and bool(self._replicas)
+        self._replica_ack = replica_ack and (
+            bool(self._replicas) or replica_gate is not None
+        )
         #: seconds between periodic rate lines while work is flowing
         #: (SURVEY.md §5 observability; VERDICT r3 weak #6 — a
         #: long-running coordinator logged rates only at job completion)
@@ -425,7 +435,15 @@ class Coordinator:
         self._clients: Dict[int, set] = {}        # client conn → its job_ids
         self._jobs: Dict[int, _Job] = {}
         self._rotation: Deque[int] = deque()      # job_ids with queued ranges
-        self._next_job_id = 1
+        #: job-id allocation lane (tpuminter.multiloop): shard k of N
+        #: allocates ids ≡ k+1 (mod N), so the shared journal's job
+        #: records can never collide across loops and recovery can
+        #: re-partition by ``job_id % loops``. Defaults reproduce the
+        #: classic dense single-loop sequence.
+        if job_id_stride < 1 or not 0 < job_id_start <= job_id_stride:
+            raise ValueError("job_id_start must be in [1, job_id_stride]")
+        self._job_id_stride = job_id_stride
+        self._next_job_id = job_id_start
         self._next_chunk_id = 1
         #: acknowledged winners by (client_key, client_job_id): the
         #: exactly-once seam — a re-submitted request id is answered
@@ -479,6 +497,7 @@ class Coordinator:
         journal_tick_flush: bool = True,
         replicate_to: Optional[List[Tuple[str, int]]] = None,
         replica_ack: bool = False,
+        io_batch: Optional[bool] = None,
     ) -> "Coordinator":
         """``recover_from`` names a write-ahead journal file
         (``tpuminter.journal``): if it exists its records are replayed —
@@ -486,7 +505,9 @@ class Coordinator:
         come back for duplicate-request suppression — and the
         coordinator journals every transition onward. The journal's
         monotone boot epoch becomes the LSP server's, so reconnecting
-        peers always see the restart."""
+        peers always see the restart. ``io_batch`` pins the transport's
+        batched-I/O mode (None = the transport default; the PERF.md
+        §Round 11 A/B knob)."""
         journal = None
         recovered: Optional[RecoveredState] = None
         boot_epoch: Optional[int] = None
@@ -494,7 +515,8 @@ class Coordinator:
             journal, recovered = Journal.open(recover_from)
             boot_epoch = recovered.boot_epoch
         server = await LspServer.create(
-            port, params or FAST, host=host, boot_epoch=boot_epoch
+            port, params or FAST, host=host, boot_epoch=boot_epoch,
+            io_batch=io_batch,
         )
         coord = cls(
             server, chunk_size=chunk_size, hedge_after=hedge_after,
@@ -521,7 +543,14 @@ class Coordinator:
         journaled job resumes as an UNBOUND job over its un-settled
         ranges (its durable client re-binds by re-submitting), every
         acknowledged winner re-enters the dedup table."""
-        self._next_job_id = max(self._next_job_id, recovered.next_job_id)
+        if recovered.next_job_id > self._next_job_id:
+            # stay in this shard's id lane: the next id at or past the
+            # recovered high-water with the same phase (stride 1: the
+            # classic dense sequence, unchanged)
+            stride = self._job_id_stride
+            phase = self._next_job_id % stride
+            nxt = recovered.next_job_id
+            self._next_job_id = nxt + (phase - nxt % stride) % stride
         for (ckey, cjid), rec in recovered.winners.items():
             # replayed winners are durable by construction: they came
             # off the fsynced record stream
@@ -787,6 +816,21 @@ class Coordinator:
         while True:
             await asyncio.sleep(self._stats_interval)
             cur = self.stats["hashes"]
+            if self._rotation and not self._miners:
+                # queued work and NOBODY to mine it. On a single-loop
+                # coordinator that means no worker is connected at all;
+                # on a multi-loop shard it is usually the small-fleet
+                # affinity hazard — jobs mine on their client's shard,
+                # and this shard drew clients but no miners. The fix is
+                # fleet size (≥ ~8 workers per loop makes an empty
+                # shard statistically impossible), not waiting.
+                log.warning(
+                    "%d job(s) queued but NO miners are connected to "
+                    "this %s — they will not progress until a worker "
+                    "joins here",
+                    len(self._rotation),
+                    "shard" if self._job_id_stride > 1 else "coordinator",
+                )
             if cur == last and not self._jobs:
                 continue
             busy = sum(1 for m in self._miners.values() if m.busy)
@@ -1020,7 +1064,7 @@ class Coordinator:
                     self._rebind_job(job, conn_id)
                     return
         job_id = self._next_job_id
-        self._next_job_id += 1
+        self._next_job_id += self._job_id_stride
         job = _Job(
             job_id=job_id,
             client_conn=conn_id,
@@ -1610,7 +1654,12 @@ class Coordinator:
         """The locally-durable finish record must also be standby-acked
         before the answer releases (``replica_ack=True``). Fired as the
         journal's on_durable callback, so ``journal.size`` already
-        covers the record it gates."""
+        covers the record it gates. A sharded coordinator routes
+        through the injected ``replica_gate`` instead — its shipping
+        lanes live on the writer loop (tpuminter.multiloop)."""
+        if self._replica_gate is not None:
+            self._replica_gate(self._journal.size, cb)
+            return
         from tpuminter.replication import gate_any
 
         gate_any(self._replicas, self._journal.size, cb)
@@ -1983,6 +2032,29 @@ def main(argv: Optional[list] = None) -> None:
         "path everywhere — decode always accepts both)",
     )
     parser.add_argument(
+        "--loops", type=int, default=1, metavar="N",
+        help="shard the coordinator across N event loops, one "
+        "SO_REUSEPORT socket each (tpuminter.multiloop): peers are "
+        "partitioned by a stable connection hash and, where the kernel "
+        "allows, steered by a reuseport BPF program — the scale-out "
+        "past the single-loop epoll floor (default 1). N > 1 on a host "
+        "that cannot shard is an ERROR, never a silent fallback",
+    )
+    parser.add_argument(
+        "--io-batch", choices=("on", "off"), default="on",
+        help="batched socket I/O: drain a bounded recvfrom burst per "
+        "epoll wakeup and group each tick's sends (default on; off = "
+        "the stdlib asyncio transport, the A/B baseline)",
+    )
+    parser.add_argument(
+        "--journal-mode", choices=("writer", "segments"),
+        default="writer",
+        help="with --loops N > 1 and --journal: 'writer' keeps ONE "
+        "WAL on the writer loop fed by per-shard queues (default; "
+        "required for --replicate-to), 'segments' gives each loop a "
+        "private WAL merged at recovery",
+    )
+    parser.add_argument(
         "--journal", metavar="PATH", default=None,
         help="write-ahead job journal: every job/chunk/winner "
         "transition is appended (batched + fsynced off the event "
@@ -2022,6 +2094,51 @@ def main(argv: Optional[list] = None) -> None:
     async def _run() -> None:
         from tpuminter.replication import parse_addr_list
 
+        replicate_to = (
+            parse_addr_list(args.replicate_to)
+            if args.replicate_to else None
+        )
+        if args.loops > 1:
+            from tpuminter.multiloop import MultiLoopCoordinator
+
+            coord = await MultiLoopCoordinator.create(
+                args.port, loops=args.loops,
+                chunk_size=args.chunk_size,
+                hedge_after=args.hedge_after,
+                audit_rate=args.audit_rate,
+                stats_interval=args.stats_interval,
+                recover_from=args.journal,
+                journal_mode=args.journal_mode,
+                pipeline_depth=args.pipeline_depth,
+                binary_codec=args.codec == "binary",
+                journal_tick_flush=args.journal_flush == "tick",
+                replicate_to=replicate_to,
+                replica_ack=args.replica_ack,
+                io_batch=args.io_batch == "on",
+            )
+            log.info(
+                "coordinator listening on port %d (%d loops)",
+                coord.port, args.loops,
+            )
+            if args.stats_port is not None:
+                log.warning(
+                    "--stats-port is not available with --loops > 1 yet; "
+                    "per-shard stats land in the log"
+                )
+            import signal
+
+            asyncio.get_running_loop().add_signal_handler(
+                signal.SIGUSR1,
+                lambda: log.info(
+                    "stats: %s",
+                    json.dumps({
+                        "stats": coord.stats,
+                        "shards": coord.shard_metrics(),
+                    }),
+                ),
+            )
+            await coord.serve()
+            return
         coord = await Coordinator.create(
             args.port, chunk_size=args.chunk_size,
             hedge_after=args.hedge_after,
@@ -2031,11 +2148,9 @@ def main(argv: Optional[list] = None) -> None:
             pipeline_depth=args.pipeline_depth,
             binary_codec=args.codec == "binary",
             journal_tick_flush=args.journal_flush == "tick",
-            replicate_to=(
-                parse_addr_list(args.replicate_to)
-                if args.replicate_to else None
-            ),
+            replicate_to=replicate_to,
             replica_ack=args.replica_ack,
+            io_batch=args.io_batch == "on",
         )
         log.info("coordinator listening on port %d", coord.port)
         if args.stats_port is not None:

@@ -84,11 +84,15 @@ __all__ = [
     "encode_settle",
     "decode_settle",
     "scan",
+    "scan_file",
+    "segment_paths",
     "scan_with_cursor",
     "read_span",
     "cursor_valid",
     "replay",
     "merge_ranges",
+    "merge_states",
+    "intersect_ranges",
     "subtract_range",
     "WINNERS_CAP",
 ]
@@ -117,6 +121,20 @@ INLINE_FSYNC_BUDGET_S = 0.002
 #: pile onto one ``write`` (the ACK_DELAY_S move applied to disk). A
 #: batch holding a durability callback is never delayed by this.
 BATCH_WINDOW_S = 0.002
+
+#: Cross-job group commit (ISSUE 6 satellite; PERF.md §Round 10 named
+#: this the next journal lever): a batch that DOES gate winner
+#: acknowledgements lingers this long before its fsync, so a burst of
+#: finish records from different jobs shares ONE write+fsync instead of
+#: paying one per winner. MEASURED A LOSS on this host and therefore
+#: OFF by default (PERF.md §Round 11): the window halves the fsync
+#: count exactly as designed, but it also adds its length to every
+#: winner acknowledgement, and closed-loop clients are latency-bound —
+#: fleet-8 throughput fell ~28% while the fsyncs it saved were worth
+#: ~2% (inline fsync ~0.15 ms at ~120 syncs/s). The trade only makes
+#: sense where fsync is genuinely expensive (ms-class disks); flip
+#: ``Journal.group_commit = True`` there, or for A/B runs.
+GROUP_COMMIT_WINDOW_S = 0.005
 
 
 # ---------------------------------------------------------------------------
@@ -274,6 +292,29 @@ def merge_ranges(ranges: List[Tuple[int, int]]) -> List[Tuple[int, int]]:
             out[-1] = (out[-1][0], max(out[-1][1], hi))
         else:
             out.append((lo, hi))
+    return out
+
+
+def intersect_ranges(
+    a: List[Tuple[int, int]], b: List[Tuple[int, int]]
+) -> List[Tuple[int, int]]:
+    """Intersect two lists of disjoint sorted inclusive intervals —
+    the segment-merge rule for a job whose coverage appears in more
+    than one WAL stream (a crash between the sharded-startup rewrite
+    and the old files' deletion): settles only ever SHRINK remaining
+    work, so the true remaining coverage is what every stream still
+    agrees is un-mined."""
+    out: List[Tuple[int, int]] = []
+    i = j = 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if lo <= hi:
+            out.append((lo, hi))
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
     return out
 
 
@@ -451,6 +492,71 @@ def replay(records: List[dict]) -> RecoveredState:
     return state
 
 
+def segment_paths(path: str) -> List[str]:
+    """Per-loop WAL segment files next to ``path`` (the segmented
+    journal mode's ``path.s<k>`` naming; sorted for determinism —
+    merge order does not matter)."""
+    import glob as _glob
+
+    return sorted(_glob.glob(path + ".s[0-9]*"))
+
+
+def scan_file(path: str) -> List[dict]:
+    """Decode the valid record prefix of the journal at ``path``
+    (missing file = no records). Pure read — never truncates; the
+    sharded-recovery caller rewrites the files wholesale anyway."""
+    if not os.path.exists(path):
+        return []
+    with open(path, "rb") as fh:
+        data = fh.read()
+    records, _clean = scan(data)
+    return records
+
+
+def merge_states(states: List[RecoveredState]) -> RecoveredState:
+    """Reassemble per-loop WAL segments into the single-journal
+    recovered state (ISSUE 6): each segment was replayed independently
+    (a segment may open with its own compacting snapshot, which resets
+    only *that* stream), and the union is well-defined because jobs are
+    shard-affine — every record of one job lives in exactly one
+    segment. The one overlap case — the same job id present in two
+    streams, possible only when a crash interrupted the sharded-startup
+    rewrite before the superseded files were deleted — merges
+    conservatively: remaining coverage intersects (settles only ever
+    shrink it; anything either stream still calls un-mined re-mines),
+    the min-fold takes the smaller best, hashes take the max. A job any
+    stream saw finish/abandon stays finished everywhere."""
+    out = RecoveredState()
+    for st in states:
+        out.boot_epoch = max(out.boot_epoch, st.boot_epoch)
+        out.next_job_id = max(out.next_job_id, st.next_job_id)
+        out.records += st.records
+        out.finished |= st.finished
+        for jid, job in st.jobs.items():
+            cur = out.jobs.get(jid)
+            if cur is None:
+                out.jobs[jid] = RecoveredJob(
+                    job_id=job.job_id, request=job.request,
+                    remaining=list(job.remaining), best=job.best,
+                    hashes_done=job.hashes_done,
+                )
+                continue
+            cur.remaining = intersect_ranges(cur.remaining, job.remaining)
+            cur.hashes_done = max(cur.hashes_done, job.hashes_done)
+            if job.best is not None and (
+                cur.best is None or job.best < cur.best
+            ):
+                cur.best = job.best
+        for key, w in st.winners.items():
+            out.winners.pop(key, None)
+            out.winners[key] = dict(w)
+    for jid in out.finished:
+        out.jobs.pop(jid, None)
+    while len(out.winners) > WINNERS_CAP:
+        out.winners.popitem(last=False)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # the journal itself (runtime)
 # ---------------------------------------------------------------------------
@@ -508,6 +614,11 @@ class Journal:
         #: flusher task is not spawned per append — only a rare fallback
         #: timer covers appends that happen outside serve ticks
         self.tick_flush = False
+        #: cross-job group commit of winner-gating batches (see
+        #: GROUP_COMMIT_WINDOW_S — measured a LOSS on this fast-fsync
+        #: host, so the default keeps the PR 3–5 fsync-per-batch
+        #: behavior; True is the knob for slow-disk deployments)
+        self.group_commit = False
         self._tick_timer_armed = False
         self.stats = {
             "records": 0,
@@ -521,7 +632,13 @@ class Journal:
 
     @classmethod
     def open(cls, path: str, **kwargs) -> Tuple["Journal", RecoveredState]:
-        """Open (or create) the journal at ``path`` and replay it."""
+        """Open (or create) the journal at ``path`` and replay it.
+
+        Any per-loop WAL segments a sharded run left next to it
+        (``path.s<k>``, tpuminter.multiloop's segmented journal mode)
+        are merged into the recovered state, re-snapshotted into this
+        file, and deleted — a restart may freely cross journal modes
+        and loop counts without losing coverage."""
         records: List[dict] = []
         if os.path.exists(path):
             with open(path, "rb") as fh:
@@ -533,18 +650,74 @@ class Journal:
                 with open(path, "r+b") as fh:
                     fh.truncate(clean)
         state = replay(records)
+        seg_paths = segment_paths(path)
+        if seg_paths:
+            state = merge_states(
+                [state] + [replay(scan_file(p)) for p in seg_paths]
+            )
         state.boot_epoch += 1
         journal = cls(path, **kwargs)
         journal.boot_epoch = state.boot_epoch
         journal._fh = open(path, "ab")
         journal.size = journal._fh.tell()
         # the boot record is durable BEFORE the server advertises the
-        # epoch: a crash right after startup must not reuse it
-        journal._write_sync(
-            encode_record({"k": "boot", "epoch": state.boot_epoch}), True
-        )
+        # epoch: a crash right after startup must not reuse it. With
+        # segments absorbed, the merged snapshot rides the same durable
+        # write, so deleting them below can never lose state.
+        blob = encode_record({"k": "boot", "epoch": state.boot_epoch})
         journal.stats["records"] += 1
+        if seg_paths:
+            blob += encode_record(state.snapshot_obj())
+            journal.stats["records"] += 1
+        journal._write_sync(blob, True)
+        for p in seg_paths:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
         return journal, state
+
+    @classmethod
+    def fresh(
+        cls, path: str, epoch: int, snapshot: Optional[dict] = None,
+        **kwargs,
+    ) -> "Journal":
+        """Create (TRUNCATING) the journal at ``path`` seeded with a
+        durable ``boot`` record and an optional ``snapshot`` — the
+        sharded-startup rewrite (``tpuminter.multiloop``): after merged
+        recovery, the recovered state is re-written as one snapshot per
+        target file (the whole state for the single-writer journal, the
+        shard's job partition + the full winners table per per-loop
+        segment) and the superseded files are deleted. The new prefix
+        is built in a temp file, fsynced, and ``os.replace``d into
+        place — the moment of truncation IS the moment the replacement
+        is durable, so a crash mid-startup either still has the old
+        file intact or a complete new prefix, never an empty WAL
+        (in-place ``open(path, 'wb')`` would lose the only durable
+        copy to a kill -9 landing before the fsync)."""
+        blob = encode_record({"k": "boot", "epoch": epoch})
+        records = 1
+        if snapshot is not None:
+            blob += encode_record(snapshot)
+            records += 1
+        journal = cls(path, **kwargs)
+        journal.boot_epoch = epoch
+        tmp = path + ".rewrite"
+        with open(tmp, "wb") as fh:
+            fh.write(blob)
+            fh.flush()
+            if journal._fsync:
+                os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        journal._fh = open(path, "ab")
+        journal.size = len(blob)
+        journal._bytes_since_compact = len(blob)
+        journal.stats["records"] += records
+        journal.stats["flushes"] += 1
+        journal.stats["bytes"] += len(blob)
+        if journal._fsync:
+            journal.stats["syncs"] += 1
+        return journal
 
     @classmethod
     def adopt(cls, path: str, epoch: int, **kwargs) -> "Journal":
@@ -717,6 +890,14 @@ class Journal:
                 # never waits here: the serve loop's burst cadence is
                 # the batching.)
                 await asyncio.sleep(BATCH_WINDOW_S)
+            elif self.group_commit and any(
+                cb is not None for _, cb in self._buffer
+            ):
+                # cross-job group commit: a winner-gating batch lingers
+                # one window so concurrent finishes (and whatever
+                # settles arrive meanwhile) ride the same write+fsync —
+                # one sync per winner BURST, not per winner
+                await asyncio.sleep(GROUP_COMMIT_WINDOW_S)
             buf, self._buffer = self._buffer, []
             if not buf:
                 continue

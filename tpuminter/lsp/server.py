@@ -41,6 +41,15 @@ class LspServer:
         self._by_id: Dict[int, ConnState] = {}
         self._addr_of: Dict[int, Addr] = {}
         self._next_conn_id = 1
+        #: conn-id allocation stride (multiloop sharding: shard k of N
+        #: allocates ids ≡ k (mod N), so the kernel's reuseport steering
+        #: program can route every established peer's datagram straight
+        #: to the owning loop by ``conn_id % N``)
+        self._conn_id_stride = 1
+        #: per-tick grouped send pass: while set, conn flushes append
+        #: (addr, wires) here instead of writing the socket one conn at
+        #: a time; _flush_dirty hands the whole tick to send_grouped
+        self._tick_pairs = None
         self._events: "asyncio.Queue[Tuple[int, Optional[bytes]]]" = asyncio.Queue()
         self._epoch_task: Optional[asyncio.Task] = None
         # coalesced-ack bookkeeping: conns with pending acks, flushed
@@ -68,9 +77,21 @@ class LspServer:
         host: str = "127.0.0.1",
         seed: Optional[int] = None,
         boot_epoch: Optional[int] = None,
+        reuse_port: bool = False,
+        io_batch: Optional[bool] = None,
+        conn_id_start: int = 1,
+        conn_id_stride: int = 1,
+        ingress_filter=None,
     ) -> "LspServer":
+        """``conn_id_start``/``conn_id_stride`` partition the conn-id
+        space across a multi-loop shard group; ``ingress_filter(data,
+        addr) -> bool`` (multiloop's steering shim) sees every datagram
+        first and returns False to swallow it (it was handed off to the
+        owning shard)."""
         self = cls()
         self._params = params or Params()
+        self._next_conn_id = conn_id_start
+        self._conn_id_stride = max(1, conn_id_stride)
         # journaled owners pass their durable monotone epoch; everyone
         # else gets a random nonzero one — distinct across restarts with
         # 2^-63 collision odds, which is all the detection needs
@@ -78,11 +99,24 @@ class LspServer:
             boot_epoch if boot_epoch is not None
             else (random.getrandbits(63) | 1)
         )
+        if ingress_filter is None:
+            on_datagram = self._on_datagram
+        else:
+            def on_datagram(data, addr, _f=ingress_filter):
+                if _f(data, addr):
+                    self._on_datagram(data, addr)
         self._endpoint = await UdpEndpoint.create(
-            self._on_datagram, local_addr=(host, port), seed=seed
+            on_datagram, local_addr=(host, port), seed=seed,
+            reuse_port=reuse_port, io_batch=io_batch,
         )
         self._epoch_task = asyncio.ensure_future(self._epoch_loop())
         return self
+
+    def deliver_datagram(self, data: bytes, addr: Addr) -> None:
+        """Inject one datagram as if the socket had received it — the
+        multiloop handoff shim's delivery seam (a datagram the kernel
+        steered to a sibling loop lands here on the owning loop)."""
+        self._on_datagram(data, addr)
 
     # -- wiring ----------------------------------------------------------
 
@@ -144,7 +178,7 @@ class LspServer:
 
     def _new_conn(self, addr: Addr) -> ConnState:
         conn_id = self._next_conn_id
-        self._next_conn_id += 1
+        self._next_conn_id += self._conn_id_stride
         conn = ConnState(
             conn_id,
             self._params,
@@ -172,14 +206,27 @@ class LspServer:
     def _flush_dirty(self) -> None:
         self._ack_flush_scheduled = False
         dirty, self._ack_dirty = self._ack_dirty, set()
-        for conn in dirty:
-            conn.flush_tx()
+        # one grouped send pass for the whole tick: each conn's flush
+        # appends its bundled datagrams to _tick_pairs instead of
+        # hitting the socket per peer (transport.send_grouped)
+        pairs = self._tick_pairs = []
+        try:
+            for conn in dirty:
+                conn.flush_tx()
+        finally:
+            self._tick_pairs = None
+        if pairs:
+            assert self._endpoint is not None
+            self._endpoint.send_grouped(pairs)
 
     def _send_to(self, addr: Addr, frame: Frame) -> None:
         assert self._endpoint is not None
         self._endpoint.send(encode(frame), addr)
 
     def _send_wires_to(self, addr: Addr, wires) -> None:
+        if self._tick_pairs is not None:
+            self._tick_pairs.append((addr, wires))
+            return
         assert self._endpoint is not None
         self._endpoint.send_batch(wires, addr)
 

@@ -4,16 +4,46 @@
 LSP layer, wrapping the raw socket and exposing read/write drop-rate
 setters so tests simulate lossy networks on localhost without a real lossy
 link — SURVEY.md §4's "own the transport seam, inject faults at it".
+
+**Batched socket I/O** (ISSUE 6): the stdlib asyncio datagram transport
+wakes the event loop once per datagram — one ``recvfrom``, one protocol
+callback, one epoll re-arm each. At fleet-64 rates that per-datagram
+callback machinery is a measured slice of the Round 7/9 "stdlib epoll
+floor". The default mode here (``io_batch=True``) therefore owns the
+socket directly: ``loop.add_reader`` fires once per readability edge and
+a bounded burst of ``recvfrom`` calls (:data:`RECV_BURST`) drains
+everything the kernel has queued before handing the loop back — one
+wakeup per *burst*, not per datagram. Sends go straight to ``sendto``
+with a small retained buffer + ``add_writer`` drain for the (loopback-
+rare) EAGAIN case, so reliability semantics match the asyncio transport
+exactly. ``io_batch=False`` restores the stdlib transport — the A/B
+baseline ``loadgen --io-batch off`` measures against.
+
+``reuse_port=True`` binds with ``SO_REUSEPORT`` — the multi-loop sharded
+coordinator (``tpuminter.multiloop``) binds N sockets to one port, one
+per event loop, and lets the kernel steer datagrams between them.
 """
 
 from __future__ import annotations
 
 import asyncio
 import random
-from typing import Awaitable, Callable, Optional, Tuple, Union
+import socket as _socket
+from collections import deque
+from typing import Awaitable, Callable, Deque, List, Optional, Tuple, Union
 
 Addr = Tuple[str, int]
 DatagramHandler = Callable[[bytes, Addr], Union[None, Awaitable[None]]]
+
+#: Datagrams drained per ``add_reader`` wakeup in batched mode. Bounds
+#: the time one endpoint can hold the loop (a storm still yields to
+#: timers/peers every burst); far above the per-tick arrival rate of a
+#: healthy fleet, so steady state is one wakeup per kernel-queued burst.
+RECV_BURST = 64
+
+#: Default I/O mode for new endpoints (the PERF.md §Round 11 A/B knob:
+#: ``loadgen --io-batch off`` flips it back to the stdlib transport).
+IO_BATCH_DEFAULT = True
 
 
 class UdpEndpoint(asyncio.DatagramProtocol):
@@ -30,6 +60,10 @@ class UdpEndpoint(asyncio.DatagramProtocol):
     - ``write_dup_rate`` / ``read_dup_rate`` — deliver it twice.
     - ``write_reorder_rate`` / ``read_reorder_rate`` — hold it back
       ``reorder_delay`` seconds so later datagrams overtake it.
+
+    Fault injection lives ABOVE the I/O mode (it runs in
+    ``datagram_received``/``send``), so batched and stdlib modes are
+    statistically indistinguishable to the layers up.
     """
 
     def __init__(self, on_datagram: DatagramHandler, seed: Optional[int] = None):
@@ -43,7 +77,15 @@ class UdpEndpoint(asyncio.DatagramProtocol):
         self.read_reorder_rate = 0.0
         self.reorder_delay = 0.05
         self._transport: Optional[asyncio.DatagramTransport] = None
-        self._closed = asyncio.get_running_loop().create_future()
+        #: batched mode: the raw socket we own (None in stdlib mode)
+        self._sock: Optional[_socket.socket] = None
+        self._loop = asyncio.get_running_loop()
+        self._closing = False
+        self._closed = self._loop.create_future()
+        #: batched mode: datagrams parked on EAGAIN, drained by
+        #: ``add_writer`` (loopback-rare; preserves no-loss semantics)
+        self._send_backlog: Deque[Tuple[bytes, Addr]] = deque()
+        self._writer_armed = False
         #: Counters for tests/metrics.
         self.sent = 0
         self.received = 0
@@ -57,6 +99,9 @@ class UdpEndpoint(asyncio.DatagramProtocol):
         self.duplicated_in = 0
         self.reordered_out = 0
         self.reordered_in = 0
+        #: batched-read evidence: wakeups vs datagrams drained (a ratio
+        #: well under 1 wakeup/datagram is the batching working)
+        self.read_wakeups = 0
 
     @classmethod
     async def create(
@@ -64,18 +109,66 @@ class UdpEndpoint(asyncio.DatagramProtocol):
         on_datagram: DatagramHandler,
         local_addr: Optional[Addr] = None,
         seed: Optional[int] = None,
+        *,
+        reuse_port: bool = False,
+        io_batch: Optional[bool] = None,
     ) -> "UdpEndpoint":
         loop = asyncio.get_running_loop()
-        _, protocol = await loop.create_datagram_endpoint(
-            lambda: cls(on_datagram, seed=seed),
-            local_addr=local_addr or ("0.0.0.0", 0),
-        )
-        return protocol
+        if io_batch is None:
+            io_batch = IO_BATCH_DEFAULT
+        if not io_batch:
+            _, protocol = await loop.create_datagram_endpoint(
+                lambda: cls(on_datagram, seed=seed),
+                local_addr=local_addr or ("0.0.0.0", 0),
+                reuse_port=reuse_port or None,
+            )
+            return protocol
+        # batched mode: own the socket, drain bursts per readability edge
+        self = cls(on_datagram, seed=seed)
+        sock = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+        try:
+            if reuse_port:
+                sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEPORT, 1)
+            sock.setblocking(False)
+            sock.bind(local_addr or ("0.0.0.0", 0))
+        except BaseException:
+            sock.close()
+            raise
+        self._sock = sock
+        loop.add_reader(sock.fileno(), self._on_readable)
+        return self
 
-    # -- asyncio.DatagramProtocol ----------------------------------------
+    # -- asyncio.DatagramProtocol (stdlib mode) --------------------------
 
     def connection_made(self, transport: asyncio.BaseTransport) -> None:
         self._transport = transport  # type: ignore[assignment]
+
+    def connection_lost(self, exc: Optional[Exception]) -> None:
+        if not self._closed.done():
+            self._closed.set_result(None)
+
+    # -- batched-read path ----------------------------------------------
+
+    def _on_readable(self) -> None:
+        """One readability edge: drain up to :data:`RECV_BURST`
+        datagrams before yielding the loop back — the recvmmsg-style
+        move (Python exposes no recvmmsg; the savings here are the
+        per-datagram epoll re-arm + callback scheduling, not the
+        syscall itself)."""
+        sock = self._sock
+        if sock is None or self._closing:
+            return
+        self.read_wakeups += 1
+        for _ in range(RECV_BURST):
+            if self._closing:
+                return  # a handler closed us mid-burst
+            try:
+                data, addr = sock.recvfrom(65536)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return  # socket died under us; close() handles lifecycle
+            self.datagram_received(data, addr[:2])
 
     def datagram_received(self, data: bytes, addr: Addr) -> None:
         if self.read_drop_rate > 0 and self._rng.random() < self.read_drop_rate:
@@ -91,14 +184,14 @@ class UdpEndpoint(asyncio.DatagramProtocol):
                 and self._rng.random() < self.read_reorder_rate
             ):
                 self.reordered_in += 1
-                asyncio.get_running_loop().call_later(
+                self._loop.call_later(
                     self.reorder_delay, self._deliver, data, addr
                 )
             else:
                 self._deliver(data, addr)
 
     def _deliver(self, data: bytes, addr: Addr) -> None:
-        if self._transport is None or self._transport.is_closing():
+        if self._is_closing():
             return  # a held-back (reordered) datagram outlived the socket
         self.received += 1
         self.received_bytes += len(data)
@@ -106,20 +199,30 @@ class UdpEndpoint(asyncio.DatagramProtocol):
         if asyncio.iscoroutine(result):
             asyncio.ensure_future(result)
 
-    def connection_lost(self, exc: Optional[Exception]) -> None:
-        if not self._closed.done():
-            self._closed.set_result(None)
-
     # -- public API ------------------------------------------------------
+
+    def _is_closing(self) -> bool:
+        if self._sock is not None:
+            return self._closing
+        return self._transport is None or self._transport.is_closing()
 
     @property
     def local_addr(self) -> Addr:
+        if self._sock is not None:
+            return self._sock.getsockname()[:2]
         assert self._transport is not None
         return self._transport.get_extra_info("sockname")[:2]
 
+    @property
+    def sock(self) -> Optional[_socket.socket]:
+        """The raw socket in batched mode (None in stdlib mode) — the
+        seam ``tpuminter.multiloop`` attaches its ``SO_ATTACH_REUSEPORT_
+        CBPF`` steering program through."""
+        return self._sock
+
     def send(self, data: bytes, addr: Addr) -> None:
         """Send one datagram (subject to the injected write faults)."""
-        if self._transport is None or self._transport.is_closing():
+        if self._is_closing():
             return
         if self.write_drop_rate > 0 and self._rng.random() < self.write_drop_rate:
             self.dropped_out += 1
@@ -134,7 +237,7 @@ class UdpEndpoint(asyncio.DatagramProtocol):
                 and self._rng.random() < self.write_reorder_rate
             ):
                 self.reordered_out += 1
-                asyncio.get_running_loop().call_later(
+                self._loop.call_later(
                     self.reorder_delay, self._send_now, data, addr
                 )
             else:
@@ -155,20 +258,81 @@ class UdpEndpoint(asyncio.DatagramProtocol):
             for data in datagrams:
                 self.send(data, addr)
             return
-        if self._transport is None or self._transport.is_closing():
+        if self._is_closing():
             return
-        sendto = self._transport.sendto
         for data in datagrams:
-            self.sent += 1
-            self.sent_bytes += len(data)
-            sendto(data, addr)
+            self._send_raw(data, addr)
 
-    def _send_now(self, data: bytes, addr: Addr) -> None:
-        if self._transport is None or self._transport.is_closing():
-            return  # a held-back (reordered) datagram outlived the socket
+    def send_grouped(self, pairs: List[Tuple[Addr, List[bytes]]]) -> None:
+        """One batched send pass for a whole event-loop tick: every
+        dirty connection's bundled datagrams, one call (the outgoing
+        half of the batched-I/O lever — the per-conn dispatch overhead
+        is paid once per tick, not once per peer). Fault-configured
+        endpoints fall back to per-datagram :meth:`send` so statistics
+        are unchanged."""
+        if (
+            self.write_drop_rate > 0
+            or self.write_dup_rate > 0
+            or self.write_reorder_rate > 0
+        ):
+            for addr, datagrams in pairs:
+                for data in datagrams:
+                    self.send(data, addr)
+            return
+        if self._is_closing():
+            return
+        for addr, datagrams in pairs:
+            for data in datagrams:
+                self._send_raw(data, addr)
+
+    def _send_raw(self, data: bytes, addr: Addr) -> None:
+        """Fault-free emission on whichever backend this endpoint runs."""
         self.sent += 1
         self.sent_bytes += len(data)
-        self._transport.sendto(data, addr)
+        if self._sock is None:
+            self._transport.sendto(data, addr)
+            return
+        if self._send_backlog:
+            self._send_backlog.append((data, addr))
+            return
+        try:
+            self._sock.sendto(data, addr)
+        except (BlockingIOError, InterruptedError):
+            self._send_backlog.append((data, addr))
+            self._arm_writer()
+        except OSError:
+            self.sent -= 1
+            self.sent_bytes -= len(data)
+            self.dropped_out += 1  # unreachable/iface error: UDP loses it
+
+    def _send_now(self, data: bytes, addr: Addr) -> None:
+        if self._is_closing():
+            return  # a held-back (reordered) datagram outlived the socket
+        self._send_raw(data, addr)
+
+    def _arm_writer(self) -> None:
+        if not self._writer_armed and self._sock is not None:
+            self._writer_armed = True
+            self._loop.add_writer(self._sock.fileno(), self._on_writable)
+
+    def _on_writable(self) -> None:
+        sock = self._sock
+        if sock is None or self._closing:
+            return
+        while self._send_backlog:
+            data, addr = self._send_backlog[0]
+            try:
+                sock.sendto(data, addr)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                # booked as sent at enqueue time; it never left
+                self.sent -= 1
+                self.sent_bytes -= len(data)
+                self.dropped_out += 1
+            self._send_backlog.popleft()
+        self._writer_armed = False
+        self._loop.remove_writer(sock.fileno())
 
     def set_write_drop_rate(self, rate: float) -> None:
         self.write_drop_rate = rate
@@ -192,6 +356,27 @@ class UdpEndpoint(asyncio.DatagramProtocol):
             self.write_reorder_rate = self.read_reorder_rate = reorder
 
     def close(self) -> None:
+        if self._sock is not None:
+            if self._closing:
+                return
+            self._closing = True
+            try:
+                self._loop.remove_reader(self._sock.fileno())
+                if self._writer_armed:
+                    self._loop.remove_writer(self._sock.fileno())
+            except (OSError, ValueError):
+                pass
+            self._sock.close()
+            self._sock = None
+            for data, _addr in self._send_backlog:
+                # booked as sent at enqueue time; they never left
+                self.sent -= 1
+                self.sent_bytes -= len(data)
+                self.dropped_out += 1
+            self._send_backlog.clear()
+            if not self._closed.done():
+                self._closed.set_result(None)
+            return
         if self._transport is not None and not self._transport.is_closing():
             self._transport.close()
 

@@ -51,6 +51,16 @@ reconnect/re-submit paths given an address list (``--coordinator
 host:port,host:port``): the un-promoted standby rejects their dials the
 same way, so the fleet keeps rotating until promotion, then lands.
 
+**Sharded primaries** (ISSUE 6, ``tpuminter.multiloop``): shipping is
+loop-affine — a lane lives on ONE event loop with the journal it tails.
+A multi-loop coordinator therefore replicates only in the single-writer
+journal mode: all shards feed one WAL on the writer loop, the lanes run
+there, and the standby sees exactly the coherent byte stream it always
+did (per-loop segmented journals cannot ship; ``MultiLoopCoordinator``
+rejects the combination loudly). Replica-ack gates registered by other
+shards are routed onto the writer loop and their releases bounced back
+(``Coordinator.replica_gate``), so gate/ack state never crosses threads.
+
 CLI (the standby/takeover role)::
 
     python -m tpuminter.replication <primary-host:port> --wal standby.wal \
