@@ -850,6 +850,111 @@ def bench_multiloop(fleet: int = 64, duration: float = 4.0,
     }
 
 
+def bench_rolled(pairs: int = 5, nb_points=(8, 12), width: int = 256,
+                 roll_batch: int = 8) -> dict:
+    """Batched extranonce rolling A/B (ISSUE 7): the data plane's
+    segment-boundary cost, measured on the jnp CPU-mesh engine (the
+    exact programs tier-1 pins; the Pallas twins ship the same
+    orchestration and await the tunnel for on-silicon capture).
+
+    PAIRED alternating runs of the same exhausted rolled job —
+    ``roll_batch`` rows per dispatch vs the per-segment loop
+    (``--roll-batch 1``) — at two ``nonce_bits`` points: the
+    boundary-dominated CI regime (nb=8: one segment per 256 nonces)
+    and a mid regime (nb=12). Median-of-ratios + IQR band, min-of-k
+    rates (the host's absolute throughput swings ~2x, PERF.md §Round
+    8), plus the dispatch-count evidence: device dispatches per
+    2^nonce_bits indices must drop ~roll_batch× or the batching isn't
+    real. ``width`` 256 is the measured CPU cache knee (PERF.md §Round
+    12); both sides dispatch at the same width so the A/B isolates
+    orchestration, not shape.
+    """
+    import numpy as np
+
+    from tpuminter import rolled as _rolled
+    from tpuminter.jax_worker import JaxMiner
+    from tpuminter.protocol import PowMode, Request
+
+    rng = np.random.RandomState(12)
+    prefix, suffix = rng.bytes(41), rng.bytes(60)
+    branch = (rng.bytes(32), rng.bytes(32))
+    hdr80 = chain.GENESIS_HEADER.pack()
+    out = {}
+
+    def drain_rate(gen):
+        t0 = time.perf_counter()
+        result = None
+        for item in gen:
+            if item is not None:
+                result = item
+        return result.searched / (time.perf_counter() - t0)
+
+    for nb in nb_points:
+        span = min(1 << (nb + 6), 1 << 17)
+        fast_req = Request(
+            job_id=1, mode=PowMode.TARGET, lower=0, upper=span - 1,
+            header=hdr80,
+            target=chain.bits_to_target(chain.GENESIS_HEADER.bits),
+            coinbase_prefix=prefix, coinbase_suffix=suffix,
+            extranonce_size=4, branch=branch, nonce_bits=nb,
+        )
+        track_req = Request(
+            job_id=2, mode=PowMode.TARGET, lower=0, upper=(span // 2) - 1,
+            header=hdr80, target=1,  # unbeatable: exhaust + exact min
+            coinbase_prefix=prefix, coinbase_suffix=suffix,
+            extranonce_size=4, branch=branch, nonce_bits=nb,
+        )
+
+        def fast(rb, counters=None):
+            return drain_rate(_rolled.mine_rolled_fast(
+                fast_req, slab=width, roll_batch=rb, engine="jnp",
+                counters=counters,
+            ))
+
+        def track(rb):
+            return drain_rate(
+                JaxMiner(batch=width, roll_batch=rb).mine(track_req)
+            )
+
+        fast(roll_batch), fast(1), track(roll_batch), track(1)  # warm
+        f_ratios, t_ratios, f_b, f_s = [], [], [], []
+        disp = {}
+        for _ in range(pairs):
+            c_s, c_b = {}, {}
+            s = fast(1, c_s)
+            b = fast(roll_batch, c_b)
+            f_s.append(s)
+            f_b.append(b)
+            f_ratios.append(b / s)
+            t_s, t_b = track(1), track(roll_batch)
+            t_ratios.append(t_b / t_s)
+            disp = {"batched": c_b, "segmented": c_s}
+        lo, hi = _iqr_band(f_ratios)
+        seg_scale = (1 << nb) / span  # dispatches per 2^nonce_bits indices
+        out.update({
+            f"rolled_fast_mhs_batched_nb{nb}": round(max(f_b) / 1e6, 4),
+            f"rolled_fast_mhs_segmented_nb{nb}": round(max(f_s) / 1e6, 4),
+            f"rolled_fast_speedup_pct_median_nb{nb}": round(
+                100.0 * (statistics.median(f_ratios) - 1.0), 1
+            ),
+            f"rolled_fast_speedup_pct_iqr_nb{nb}": [
+                round(100.0 * (lo - 1.0), 1), round(100.0 * (hi - 1.0), 1)
+            ],
+            f"rolled_dispatches_per_segment_batched_nb{nb}": round(
+                sum(disp["batched"].values()) * seg_scale, 3
+            ),
+            f"rolled_dispatches_per_segment_segmented_nb{nb}": round(
+                sum(disp["segmented"].values()) * seg_scale, 3
+            ),
+            f"rolled_tracking_speedup_pct_median_nb{nb}": round(
+                100.0 * (statistics.median(t_ratios) - 1.0), 1
+            ),
+        })
+    out["rolled_roll_batch"] = roll_batch
+    out["rolled_width"] = width
+    return out
+
+
 def bench_native(seconds: float = 2.0) -> dict:
     """Measured native C++ double-SHA rate (README's backend table row;
     BASELINE.md quoted 1.84 MH/s on this host). Absent .so → empty."""
@@ -899,6 +1004,13 @@ def bench_jnp(batch: int, secs: float = 1.0) -> float:
 def main() -> None:
     smoke = os.environ.get("BENCH_SMOKE") == "1"
     extra = {}
+    if smoke or jax.default_backend() == "cpu":
+        # CPU captures compile the jnp engines fresh per process; the
+        # persistent cache (tests/conftest.py uses the same dir) keeps
+        # repeated captures and the tier-1 smoke out of recompile land
+        from tpuminter.xla_cache import enable_compilation_cache
+
+        enable_compilation_cache()
     if smoke:
         jax.config.update("jax_platforms", "cpu")
         rate = bench_jnp(1 << 14)
@@ -908,6 +1020,7 @@ def main() -> None:
         extra.update(bench_multiloop(fleet=8, duration=1.5, pairs=1))
         extra.update(bench_recovery(duration=1.5, pairs=1))
         extra.update(bench_replication(duration=1.5, pairs=1))
+        extra.update(bench_rolled(pairs=1, nb_points=(8,)))
         extra.update(bench_native(seconds=0.5))
     elif jax.default_backend() == "cpu":
         # the TPU tunnel is down and jax silently fell back to CPU: say
@@ -923,6 +1036,7 @@ def main() -> None:
         extra.update(bench_multiloop())
         extra.update(bench_recovery())
         extra.update(bench_replication())
+        extra.update(bench_rolled())
         extra.update(bench_native())
     else:
         # persistent compilation cache, same as the worker CLI: the
@@ -953,6 +1067,7 @@ def main() -> None:
         extra.update(bench_multiloop())
         extra.update(bench_recovery())
         extra.update(bench_replication())
+        extra.update(bench_rolled())
         extra.update(bench_native())
     ghs = rate / 1e9
     print(

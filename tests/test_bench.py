@@ -31,3 +31,11 @@ def test_bench_smoke_emits_one_json_line():
     assert obj["value"] > 0
     assert obj["vs_baseline"] == obj["value"]  # target denominator is 1.0
     assert obj["extra"]["scrypt_khs_per_chip"] > 0
+    # the rolled A/B section rides every capture (ISSUE 7): both sides
+    # of the pair measured, and the dispatch-count evidence present
+    assert obj["extra"]["rolled_fast_mhs_batched_nb8"] > 0
+    assert obj["extra"]["rolled_fast_mhs_segmented_nb8"] > 0
+    assert (
+        obj["extra"]["rolled_dispatches_per_segment_batched_nb8"]
+        < obj["extra"]["rolled_dispatches_per_segment_segmented_nb8"]
+    )

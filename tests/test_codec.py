@@ -285,3 +285,57 @@ def test_settle_records_replay_like_json_settles():
     s1, s2 = replay(recs_json), replay(recs_bin)
     assert s1.jobs[1].remaining == s2.jobs[1].remaining == [(2048, 4095)]
     assert s1.jobs[1].best == s2.jobs[1].best == (0x10, 7)
+
+
+# ---------------------------------------------------------------------------
+# rolled-job wire shape (ISSUE 7): the baseline a future codec v2 will
+# be measured against
+# ---------------------------------------------------------------------------
+
+def test_rolled_assign_wire_shape_baseline():
+    """Pin the rolled-job dispatch economics: the ragged ~1.5 kB
+    template (mainnet-shape coinbase + 12-deep branch) rides the JSON
+    long tail ONCE per (worker, job) inside Setup; every per-chunk
+    Assign stays the fixed 37-byte binary record with ZERO template
+    bytes; and binary Results carry 64-bit GLOBAL nonces, so the binary
+    codec still negotiates on rolled jobs. These numbers are the
+    recorded baseline for a codec v2 (packed Setup)."""
+    from tpuminter import chain
+
+    rng = random.Random(7)
+    prefix = bytes(rng.randrange(256) for _ in range(120))
+    suffix = bytes(rng.randrange(256) for _ in range(126))
+    branch = tuple(
+        bytes(rng.randrange(256) for _ in range(32)) for _ in range(12)
+    )
+    assert len(prefix) + 4 + len(suffix) == 250  # the realistic coinbase
+    req = Request(
+        job_id=9, mode=PowMode.TARGET, lower=0,
+        upper=(3 << 32) | 0xFFFFFFFF, header=chain.GENESIS_HEADER.pack(),
+        target=chain.bits_to_target(chain.GENESIS_HEADER.bits),
+        coinbase_prefix=prefix, coinbase_suffix=suffix,
+        extranonce_size=4, branch=branch,
+    )
+    # Setup: JSON long tail even when the connection negotiated binary
+    setup = encode_msg(Setup(req), binary=True)
+    assert setup[:1] == b"{"
+    assert 1200 <= len(setup) <= 2200, len(setup)  # ~1.5 kB mainnet shape
+    assert decode_msg(setup) == Setup(req)
+    # Assign: fixed binary width, no template bytes — sent per chunk
+    assign = Assign(9, 3, 5 << 32, (5 << 32) + (1 << 20))
+    raw = encode_msg(assign, binary=True)
+    assert raw[0] == 0xB1 and len(raw) == 37
+    assert decode_msg(raw) == assign
+    assert prefix not in raw and suffix not in raw
+    # Result: a rolled win's 64-bit global nonce fits the binary record
+    res = Result(
+        9, PowMode.TARGET, nonce=(3 << 32) | 123,
+        hash_value=(1 << 220) - 7, found=True,
+        searched=(3 << 32) | 124, chunk_id=3,
+    )
+    raw_res = encode_msg(res, binary=True)
+    assert payload_is_binary(raw_res) and raw_res[0] == 0xB2
+    assert decode_msg(raw_res) == res
+    # the per-job template cost amortizes: 100 chunks of a rolled job
+    # cost one Setup + 100 fixed Assigns, not 100 template re-sends
+    assert len(setup) + 100 * len(raw) < 100 * len(setup) // 10

@@ -181,6 +181,51 @@ r5 = drain(TpuMiner(slab=1 << 16).mine(req5))
 assert r5.found and r5.nonce == 2698 and r5.hash_value == H_MIN
 print("SECTION-OK")
 """,
+    # --- batched rolled sweep (ISSUE 7): the per-row-midstate kernel's
+    # rows ≡ singleton dynamic-header calls (found flag, first offset,
+    # dynamic valid masking), and TpuMiner's batched fast path ≡ the
+    # roll_batch=1 per-segment baseline on the same fixtures
+    "rolled_batched": r"""
+from tpuminter.kernels import (
+    pallas_search_candidates_hdr, pallas_search_candidates_hdr_batch,
+)
+from tpuminter.ops import merkle
+from tpuminter.tpu_worker import TpuMiner
+rng2 = np.random.RandomState(0)
+cb_prefix = rng2.bytes(41); cb_suffix = rng2.bytes(60)
+cb_branch = tuple(rng2.bytes(32) for _ in range(2))
+roll_b = merkle.make_extranonce_roll_batch(
+    GEN.pack(), cb_prefix, cb_suffix, 4, cb_branch)
+mids, tails = roll_b(jnp.zeros(3, jnp.uint32),
+                     jnp.asarray(np.array([0, 1, 2], np.uint32)))
+W = 1 << 14
+bases = np.array([100, 2804947108 - 5000, 100], np.uint32)  # row 1 wins
+valids = np.array([W, W, 3000], np.uint32)
+fb, ob = pallas_search_candidates_hdr_batch(
+    mids, tails, jnp.asarray(bases), jnp.asarray(valids), W, 8, cap1)
+fb, ob = np.asarray(fb), np.asarray(ob)
+for i in range(3):
+    f1, o1 = pallas_search_candidates_hdr(
+        mids[i], tails[i], jnp.uint32(int(bases[i])),
+        int(valids[i]), 8, cap1)
+    assert (int(fb[i]) != 0) == (int(f1) != 0), i
+    if int(fb[i]):
+        assert int(ob[i]) == int(o1), i
+assert int(fb[1]) == 1 and int(bases[1]) + int(ob[1]) == 2804947108
+assert int(fb[2]) == 0  # dynamic valid masking trims row 2's sweep
+
+# TpuMiner batched == per-segment baseline, fast + tracking fixtures
+TGT = 0x6d278107d5385a15ebb7b627ad622562f7bc65132eba75b00c300cde
+req7 = Request(job_id=7, mode=PowMode.TARGET, lower=0, upper=(2 << 32) - 1,
+               header=GEN.pack(), target=TGT,
+               coinbase_prefix=cb_prefix, coinbase_suffix=cb_suffix,
+               extranonce_size=4, branch=cb_branch, nonce_bits=32)
+rb = drain(TpuMiner(roll_batch=4).mine(req7))
+r1 = drain(TpuMiner(roll_batch=1).mine(req7))
+assert (rb.found, rb.nonce, rb.hash_value) == (r1.found, r1.nonce, r1.hash_value)
+assert rb.nonce == (1 << 32) + 2804947108
+print("SECTION-OK")
+""",
     # --- pod paths on the real chip (1-chip mesh): the shard_map'd Pallas
     # MIN sweep (full span + ragged tail) and the exact-min TARGET sweep
     # (build_exact_sweep_pallas: pallas_search_target per chip, pipelined

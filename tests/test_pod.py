@@ -241,3 +241,76 @@ def test_pod_miner_scrypt_sharded(mesh):
     assert not result.found
     assert (result.hash_value, result.nonce) == (h_min, n_min)
     assert result.searched == upper + 1
+
+
+def _rolled_fixture(nb=10, ens=4, seed=5):
+    rng = np.random.RandomState(seed)
+    prefix, suffix = rng.bytes(41), rng.bytes(60)
+    branch = (rng.bytes(32), rng.bytes(32))
+    import struct
+
+    cb = chain.CoinbaseTemplate(prefix, suffix, 4)
+    all_h = []
+    for en in range(ens):
+        p76 = chain.rolled_header(GEN.pack(), cb, branch, en).pack()[:76]
+        for n in range(1 << nb):
+            h = chain.hash_to_int(chain.dsha256(p76 + struct.pack("<I", n)))
+            all_h.append((h, (en << nb) | n))
+    return prefix, suffix, branch, all_h
+
+
+def test_pod_miner_rolled_batched_matches_per_segment_baseline(mesh):
+    """`--roll-batch 1` reproduces today's per-segment pod loop
+    bit-for-bit; the batched sweep (device-major row stripes through
+    build_rolled_sweep) returns the identical Result."""
+    prefix, suffix, branch, _ = _rolled_fixture()
+    nb, ens = 11, 3
+    req = Request(
+        job_id=21, mode=PowMode.TARGET, lower=100,
+        upper=(ens << nb) - 50, header=GEN.pack(),
+        target=chain.bits_to_target(GEN.bits),
+        coinbase_prefix=prefix, coinbase_suffix=suffix,
+        extranonce_size=4, branch=branch, nonce_bits=nb,
+    )
+    results = []
+    for rb in (1, 6):
+        miner = PodMiner(
+            mesh=mesh, slab_per_device=64, n_slabs=2, kernel="jnp",
+            roll_batch=rb,
+        )
+        results.append(_drain(miner.mine(req)))
+    base, batched = results
+    assert (base.found, base.nonce, base.hash_value, base.searched) == (
+        batched.found, batched.nonce, batched.hash_value, batched.searched
+    )
+    assert not base.found and base.hash_value == MIN_UNTRACKED
+    assert base.searched == req.upper - req.lower + 1
+
+
+def test_pod_miner_rolled_batched_finds_exact_first_winner(mesh):
+    """The batched pod sweep's found path at a CI-reachable candidate
+    bar (the jnp engine's `cand_bits` test seam, 8 bits): the winner is
+    the exact lowest GLOBAL winning index — the stripe-interleaved
+    early exit never skips a lower row — and the exhausted path
+    surfaces the exact candidate minimum."""
+    prefix, suffix, branch, all_h = _rolled_fixture()
+    nb, ens = 10, 4
+    cands = [(h, g) for h, g in all_h if h >> 248 == 0]
+    h_c, g_c = min(cands)
+    mk = lambda target, jid: Request(
+        job_id=jid, mode=PowMode.TARGET, lower=0, upper=(ens << nb) - 1,
+        header=GEN.pack(), target=target, coinbase_prefix=prefix,
+        coinbase_suffix=suffix, extranonce_size=4, branch=branch,
+        nonce_bits=nb,
+    )
+    miner = PodMiner(
+        mesh=mesh, slab_per_device=128, n_slabs=2, kernel="jnp",
+        roll_batch=6,
+    )
+    miner._cand_bits = 8
+    r = _drain(miner.mine(mk(h_c, 22)))
+    assert r.found and (r.nonce, r.hash_value) == (g_c, h_c)
+    assert r.nonce >> nb >= 1  # the roll actually happened
+    r2 = _drain(miner.mine(mk(1, 23)))
+    assert not r2.found and (r2.hash_value, r2.nonce) == (h_c, g_c)
+    assert r2.searched == ens << nb

@@ -807,3 +807,106 @@ def test_pipelined_crash_replay_remines_exactly_the_unsettled_ranges(
             await client.close(drain_timeout=0.1)
 
     run(scenario(), timeout=60.0)
+
+
+def test_rolled_job_survives_crash_with_batched_path(tmp_path):
+    """Rolled e2e through the durable coordinator (ISSUE 7): a rolled
+    job at brute-force-checkable difficulty survives a mid-job kill -9
+    + journal replay with the BATCHED sweep on (JaxMiner roll_batch >
+    1), and the reconnecting client gets exactly one answer — the exact
+    global minimum, equal to hashlib brute force."""
+    import struct
+
+    import numpy as np
+
+    from tpuminter.jax_worker import JaxMiner
+
+    wal = str(tmp_path / "rolled.wal")
+    nb, ens = 9, 4  # 2048 global indices, 512-nonce segments
+    rng = np.random.RandomState(11)
+    prefix, suffix = rng.bytes(41), rng.bytes(60)
+    branch = (rng.bytes(32), rng.bytes(32))
+    hdr80 = chain.GENESIS_HEADER.pack()
+    cb = chain.CoinbaseTemplate(prefix, suffix, 4)
+    want = min(
+        (
+            chain.hash_to_int(chain.dsha256(
+                chain.rolled_header(hdr80, cb, branch, en).pack()[:76]
+                + struct.pack("<I", n)
+            )),
+            (en << nb) | n,
+        )
+        for en in range(ens)
+        for n in range(1 << nb)
+    )
+    req = Request(
+        job_id=77, mode=PowMode.TARGET, lower=0, upper=(ens << nb) - 1,
+        header=hdr80, target=1,  # unbeatable: must exhaust + min-fold
+        coinbase_prefix=prefix, coinbase_suffix=suffix,
+        extranonce_size=4, branch=branch, nonce_bits=nb,
+    )
+
+    class SlowJaxMiner(JaxMiner):
+        """Batched rolled miner throttled so the crash lands mid-job."""
+
+        def mine(self, request):
+            for item in super().mine(request):
+                time.sleep(0.05)
+                yield item
+
+    async def scenario():
+        coord = await Coordinator.create(
+            params=FAST, chunk_size=256, recover_from=wal
+        )
+        port = coord.port
+        serve = asyncio.ensure_future(coord.serve())
+        miners = [
+            asyncio.ensure_future(run_miner_reconnect(
+                "127.0.0.1", port,
+                SlowJaxMiner(batch=128, roll_batch=3, lanes=1),
+                params=FAST, base_backoff=0.05, max_backoff=0.4,
+                rng=random.Random(200 + i),
+            ))
+            for i in range(2)
+        ]
+        await asyncio.sleep(0.2)
+        sub = asyncio.ensure_future(submit(
+            "127.0.0.1", port, req, params=FAST,
+            client_key="rolled-crash-client", reconnect=True,
+            base_backoff=0.05, rng=random.Random(42),
+        ))
+        coord2 = None
+        try:
+            t0 = time.monotonic()
+            while coord.stats["results_accepted"] < 2:
+                assert time.monotonic() - t0 < 30, "no progress pre-crash"
+                await asyncio.sleep(0.01)
+            assert coord.stats["jobs_done"] == 0, (
+                "crash must land mid-job; slow the miners down"
+            )
+            # -- kill -9 -------------------------------------------------
+            serve.cancel()
+            await asyncio.gather(serve, return_exceptions=True)
+            endpoint = coord.server.endpoint
+            coord.crash()
+            await endpoint.wait_closed()
+            coord2 = await _restart_coordinator(port, wal, chunk_size=256)
+            assert len(coord2._jobs) == 1  # the rolled job replayed
+            serve = asyncio.ensure_future(coord2.serve())
+            res = await asyncio.wait_for(sub, 90.0)
+            assert not res.found
+            assert (res.hash_value, res.nonce) == want
+            assert res.searched >= (ens << nb) - 256 * 2  # replay re-mines
+            assert not coord2._jobs
+        finally:
+            for t in miners + [sub]:
+                t.cancel()
+            await asyncio.gather(*miners, sub, return_exceptions=True)
+            serve.cancel()
+            await asyncio.gather(serve, return_exceptions=True)
+            if coord2 is not None:
+                await coord2.close()
+            else:
+                await coord.close()
+
+    run(scenario(), timeout=150.0)

@@ -201,3 +201,34 @@ def test_pipeline_spans_rejects_bad_depth():
 
     with pytest.raises(ValueError):
         list(pipeline_spans([1], lambda s: s, 0))
+
+
+def test_global_domain_search_crosses_segment_boundaries():
+    """The rolled generalization (ISSUE 7): one CandidateSearch over a
+    >2^32 GLOBAL index domain, slabs crossing extranonce boundaries —
+    same exact-lowest-winner contract, bookkeeping keyed by global
+    index. (The batched sweep itself is pinned in test_extranonce; this
+    pins the driver's queueing/ordering over the wide domain.)"""
+    base_g = 1 << 34  # far beyond the 32-bit nonce space
+    chip = FakeChip(
+        candidates=[base_g + 150, base_g + 9050],
+        winners=[base_g + 9050],
+    )
+    s = CandidateSearch(
+        chip.sweep, chip.resolve, chip.verify,
+        base_g - 1000, base_g + 20_000,
+        slab=4096, depth=2, domain=1 << 40,
+    )
+    for _ in s.events():
+        pass
+    out = s.outcome
+    assert out.found and out.nonce == base_g + 9050
+    assert out.candidates[0] == (base_g + 150, 1 << 230)
+    # the false positive's remainder was re-issued before later ranges
+    assert chip.verifies == [base_g + 150, base_g + 9050]
+    # without the widened domain, the same range is rejected loudly
+    with pytest.raises(ValueError):
+        CandidateSearch(
+            chip.sweep, chip.resolve, chip.verify,
+            base_g - 1000, base_g + 20_000, slab=4096,
+        )

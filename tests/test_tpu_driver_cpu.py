@@ -16,12 +16,13 @@ from tpuminter import chain, tpu_worker
 from tpuminter.protocol import MIN_UNTRACKED, PowMode, Request
 
 
-def _bare_tpu_miner(slab=1 << 12):
+def _bare_tpu_miner(slab=1 << 12, roll_batch=8):
     """TpuMiner without __init__ (which refuses the CPU backend)."""
     miner = tpu_worker.TpuMiner.__new__(tpu_worker.TpuMiner)
     miner.slab = slab
     miner.depth = 2
     miner.exact_min = False
+    miner.roll_batch = roll_batch
     miner._scrypt_delegate = None
     miner.lanes = 1
     return miner
@@ -57,13 +58,23 @@ def test_target_fast_driver_runs_on_cpu(monkeypatch):
 
 
 def test_rolled_fast_driver_runs_on_cpu(monkeypatch):
-    """The production >2^32 driver: segments × pod wiring × resolve.
-    This exact test catches the r3 resolve NameError class."""
+    """The production >2^32 driver: window planning × batched roll ×
+    resolve (and the roll_batch=1 per-segment baseline's wiring). This
+    exact test catches the r3 resolve NameError class — now with the
+    Pallas engines faked at their tpuminter.rolled seams."""
+    import tpuminter.kernels as kernels
+    from tpuminter import rolled
+
     monkeypatch.setattr(
-        tpu_worker, "pallas_search_candidates_hdr", _clean_kernel
+        rolled, "_pallas_batched_candidate_sweep",
+        lambda *a, **k: jnp.asarray(
+            np.array([0, 0xFFFFFFFF], np.uint32)
+        ),
+    )
+    monkeypatch.setattr(
+        kernels, "pallas_search_candidates_hdr", _clean_kernel
     )
     rng = np.random.RandomState(1)
-    miner = _bare_tpu_miner(slab=1 << 10)
     nb, ens = 11, 3
     req = Request(
         job_id=2, mode=PowMode.TARGET, lower=5, upper=(ens << nb) - 9,
@@ -72,10 +83,12 @@ def test_rolled_fast_driver_runs_on_cpu(monkeypatch):
         coinbase_prefix=rng.bytes(41), coinbase_suffix=rng.bytes(60),
         extranonce_size=4, branch=(rng.bytes(32),), nonce_bits=nb,
     )
-    result = _drain(miner._mine_rolled_fast(req))
-    assert not result.found
-    assert result.hash_value == MIN_UNTRACKED
-    assert result.searched == req.upper - req.lower + 1
+    for roll_batch in (8, 1):
+        miner = _bare_tpu_miner(slab=1 << 10, roll_batch=roll_batch)
+        result = _drain(miner._mine_rolled_fast(req))
+        assert not result.found, roll_batch
+        assert result.hash_value == MIN_UNTRACKED, roll_batch
+        assert result.searched == req.upper - req.lower + 1, roll_batch
 
 
 def test_target_fast_driver_finds_scripted_candidate(monkeypatch):

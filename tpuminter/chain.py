@@ -22,7 +22,7 @@ from __future__ import annotations
 import hashlib
 import struct
 from dataclasses import dataclass, replace
-from typing import Iterable, Iterator, List, Sequence, Tuple
+from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
 
 __all__ = [
     "sha256",
@@ -45,6 +45,7 @@ __all__ = [
     "rolled_header",
     "split_global",
     "rolled_segments",
+    "rolled_tiles",
     "HEADER_SIZE",
     "SHA256_H0",
     "SHA256_K",
@@ -425,3 +426,25 @@ def rolled_segments(
         seg_end = min(upper, ((en + 1) << nonce_bits) - 1)
         yield en, en << nonce_bits, idx & mask, seg_end & mask
         idx = seg_end + 1
+
+
+def rolled_tiles(
+    lower: int, upper: int, nonce_bits: int = 32, width: Optional[int] = None
+) -> Iterator[Tuple[int, int, int, int]]:
+    """:func:`rolled_segments` sub-split at ``width`` granularity: yield
+    ``(extranonce, nonce_base, count, global_base)`` tiles, each at most
+    ``width`` nonces wide and never crossing an extranonce boundary — the
+    unit of work one ROW of a batched rolled sweep covers
+    (``tpuminter.rolled``). Tiles come out in ascending global order;
+    ``global_base`` is the global index of the tile's first nonce.
+    ``width=None`` means whole segments (≡ ``rolled_segments`` reshaped).
+    """
+    for en, base_g, n_lo, n_hi in rolled_segments(lower, upper, nonce_bits):
+        if width is None or width >= (1 << nonce_bits):
+            yield en, n_lo, n_hi - n_lo + 1, base_g | n_lo
+            continue
+        b = n_lo
+        while b <= n_hi:
+            take = min(width, n_hi - b + 1)
+            yield en, b, take, base_g | b
+            b += take

@@ -107,8 +107,14 @@ class JaxMiner(Miner):
         lanes: Optional[int] = None,
         scrypt_batch: int = 256,
         depth: int = 2,
+        roll_batch: int = 8,
     ):
         self.batch = batch
+        #: extranonce rows per rolled dispatch (tpuminter.rolled): one
+        #: batched roll + one batched sweep per `roll_batch` segments'
+        #: worth of indices, pipelined across segment boundaries.
+        #: 1 = the per-segment A/B baseline (`--roll-batch 1`).
+        self.roll_batch = roll_batch
         # scrypt's ROMix scratch is 128 KiB per in-flight nonce, so the
         # memory-hard dialect gets its own (much smaller) batch size:
         # scrypt_batch × 128 KiB of V lives on device per step
@@ -290,11 +296,23 @@ class JaxMiner(Miner):
 
     def _mine_rolled(self, req: Request) -> Iterator[Optional[Result]]:
         """Extranonce-rolling TARGET search: the roll (coinbase txid →
-        branch fold → merkle root → header midstate) runs ON DEVICE once
-        per extranonce segment (``ops.merkle.make_extranonce_roll``); its
-        outputs feed the dynamic-header batch step without ever surfacing
-        to the host (BASELINE.json:9-10)."""
+        branch fold → merkle root → header midstate) runs ON DEVICE and
+        its outputs feed the dynamic-header batch step without ever
+        surfacing to the host (BASELINE.json:9-10). Default: the BATCHED
+        sweep (``tpuminter.rolled.mine_rolled_tracking``) — one roll +
+        one sweep dispatch per ``roll_batch`` rows, pipelined ``depth``
+        deep ACROSS segment boundaries. ``roll_batch=1`` keeps the
+        per-segment loop below as the A/B baseline (bit-equal results,
+        pinned in tests/test_extranonce.py)."""
         assert req.target is not None
+        if self.roll_batch > 1:
+            from tpuminter import rolled
+
+            yield from rolled.mine_rolled_tracking(
+                req, width_cap=self.batch, depth=self.depth,
+                roll_batch=self.roll_batch,
+            )
+            return
         from tpuminter.ops import merkle
 
         roll = merkle.make_extranonce_roll(

@@ -12,6 +12,7 @@ from tpuminter.kernels.sha256 import (
     pallas_min_toy,
     pallas_search_candidates,
     pallas_search_candidates_hdr,
+    pallas_search_candidates_hdr_batch,
     pallas_search_target,
     pallas_sha256_batch,
 )
@@ -21,5 +22,6 @@ __all__ = [
     "pallas_search_target",
     "pallas_search_candidates",
     "pallas_search_candidates_hdr",
+    "pallas_search_candidates_hdr_batch",
     "pallas_min_toy",
 ]

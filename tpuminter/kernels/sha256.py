@@ -51,6 +51,7 @@ __all__ = [
     "pallas_search_target",
     "pallas_search_candidates",
     "pallas_search_candidates_hdr",
+    "pallas_search_candidates_hdr_batch",
 ]
 
 LANES = 128
@@ -522,6 +523,130 @@ def pallas_search_candidates_hdr(
     )
     row = summary[0]
     return row[_FOUND], row[_FIRST_IDX]
+
+
+def _cand_hdr_batch_kernel(n_tiles, tiles_per_step,
+                           mid_ref, tw_ref, base_ref, lim_ref, cap_ref,
+                           out_ref):
+    """One grid step = one roll ROW of the batched sweep: identical
+    candidate test to ``_cand_hdr_kernel``, but the row's midstate, tail
+    words, nonce base AND valid count all arrive per-row at runtime
+    (BlockSpec-indexed SMEM rows of the ``make_extranonce_roll_batch``
+    output). The valid count is dynamic because rows are the ragged
+    ``chain.rolled_tiles`` of an arbitrary global window — the loop
+    bound trims to it (a ``valid == 0`` padding row costs zero sweep
+    iterations) and the candidate mask applies it exactly."""
+    mid = [mid_ref[0, i] for i in range(8)]
+    tail = [tw_ref[0, 0], tw_ref[0, 1], tw_ref[0, 2], 0] + list(
+        ops.HEADER_TAIL_PAD
+    )
+    cand_c = np.uint32(sym.CAND_E60)
+    offs = (
+        jax.lax.broadcasted_iota(jnp.int32, _TILE, 0) * np.int32(LANES)
+        + jax.lax.broadcasted_iota(jnp.int32, _TILE, 1)
+    )
+    base = base_ref[0]
+    cap1 = cap_ref[0]
+    limit = lim_ref[0]  # dynamic i32 valid count, NOT a trace constant
+    tile_sz = _TILE[0] * LANES
+
+    def cond(carry):
+        i, found, _ = carry
+        return (i < n_tiles) & (found == 0) & (i * np.int32(tile_sz) < limit)
+
+    def body(carry):
+        i, _, first_offs = carry
+        any_ok = jnp.zeros(_TILE, jnp.bool_)
+        for t in range(tiles_per_step):
+            offs_i = offs + (i + t) * np.int32(tile_sz)
+            nonces = base + jax.lax.bitcast_convert_type(offs_i, jnp.uint32)
+            e60, e61 = sym.hash_sym_e60_e61(
+                mid, [tail], ops.HEADER_NONCE_POSITIONS, 0, nonces
+            )
+            digest6 = sym.add(sym.DIGEST6_BIAS, e61)
+            hw1 = sym.xor(
+                sym.shl(sym.and_(digest6, 0x000000FF), 24),
+                sym.shl(sym.and_(digest6, 0x0000FF00), 8),
+                sym.shr(sym.and_(digest6, 0x00FF0000), 8),
+                sym.shr(sym.and_(digest6, 0xFF000000), 24),
+                0x80000000,
+            )
+            hw1b = jax.lax.bitcast_convert_type(hw1, jnp.int32)
+            ok = (e60 == cand_c) & (hw1b <= cap1) & (offs_i < limit)
+            any_ok = any_ok | ok
+            first_offs = jnp.where(
+                ok & (offs_i < first_offs), offs_i, first_offs
+            )
+        found = jnp.max(any_ok.astype(jnp.int32))
+        return (i + tiles_per_step, found, first_offs)
+
+    init = (jnp.int32(0), jnp.int32(0), jnp.full(_TILE, _I32MAX, jnp.int32))
+    _, found, first_offs = jax.lax.while_loop(cond, body, init)
+    first = jnp.min(first_offs)
+    lane = jax.lax.broadcasted_iota(jnp.int32, _TILE, 1)
+    row = jnp.where(lane == np.int32(_FOUND), found, jnp.zeros(_TILE, jnp.int32))
+    row = jnp.where(lane == np.int32(_FIRST_IDX), first, row)
+    out_ref[0] = jax.lax.bitcast_convert_type(row, jnp.uint32)
+
+
+@partial(jax.jit, static_argnums=(4, 5))
+def pallas_search_candidates_hdr_batch(
+    midstates: jnp.ndarray,
+    tailws: jnp.ndarray,
+    bases: jnp.ndarray,
+    valids: jnp.ndarray,
+    width: int,
+    tiles_per_step: int = 8,
+    hw1_cap: jnp.ndarray | None = None,
+):
+    """Batched twin of :func:`pallas_search_candidates_hdr`: a grid over
+    ``B`` roll rows, each sweeping up to ``width`` nonces of ITS OWN
+    dynamic header — ``(B, 8)`` midstates, ``(B, 3)`` tail batches
+    (``ops.merkle.make_extranonce_roll_batch`` outputs, straight from
+    device memory), ``(B,)`` per-row nonce bases and valid counts. One
+    dispatch sweeps ``B·width`` global indices; segment boundaries cost
+    nothing because they are just row edges of the same launch.
+
+    Returns ``(founds (B,) u32, first_offs (B,) u32)`` — per-row flags
+    and lowest candidate offsets (relative to that row's base, valid iff
+    the flag is set). Rows are masked to their ``valids`` count exactly
+    (a ragged or padding row can never surface an out-of-tile
+    candidate), so the caller's cross-row fold is a plain masked min
+    over ``global_base[row] + first_offs[row]``.
+    """
+    if not 1 <= width <= 1 << 30:
+        raise ValueError("width must be in [1, 2^30] (int32 offset domain)")
+    if hw1_cap is None:
+        hw1_cap = jnp.uint32(0xFFFFFFFF)
+    b = midstates.shape[0]
+    chunk = _TILE[0] * LANES * tiles_per_step
+    n_tiles = -(-width // chunk) * tiles_per_step
+    cap_biased = jax.lax.bitcast_convert_type(
+        hw1_cap.astype(jnp.uint32) ^ jnp.uint32(0x80000000), jnp.int32
+    )
+    summary = pl.pallas_call(
+        partial(_cand_hdr_batch_kernel, n_tiles, tiles_per_step),
+        out_shape=jax.ShapeDtypeStruct((b,) + _TILE, jnp.uint32),
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, 8), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 3), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1,), lambda i: (i,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1,), lambda i: (i,), memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1,) + _TILE, lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+        ),
+        interpret=_interpret(),
+    )(
+        midstates.astype(jnp.uint32),
+        tailws.astype(jnp.uint32),
+        bases.astype(jnp.uint32),
+        valids.astype(jnp.int32),
+        cap_biased.reshape(1),
+    )
+    return summary[:, 0, _FOUND], summary[:, 0, _FIRST_IDX]
 
 
 # ---------------------------------------------------------------------------

@@ -329,8 +329,13 @@ def header_digest_dyn(
     midstate8: jnp.ndarray, tailw3: jnp.ndarray, nonces: jnp.ndarray
 ) -> jnp.ndarray:
     """Double-SHA-256 digests for a header whose midstate and variable
-    tail words are *runtime values* (u32 arrays of shape (8,) and (3,)),
-    not trace-time constants: ``(N,) u32 nonces → (N, 8) digest words``.
+    tail words are *runtime values* (u32 arrays of shape (..., 8) and
+    (..., 3)), not trace-time constants: ``(N,) u32 nonces → (N, 8)
+    digest words`` — or, batched over roll rows, ``(B, 8) midstates +
+    (B, 3) tails + (B, N) nonces → (B, N, 8)``: row ``i``'s nonces are
+    hashed under row ``i``'s header. The batched form is the jnp engine
+    of the batched rolled sweep (``tpuminter.rolled``): one dispatch
+    sweeps every row of a ``make_extranonce_roll_batch`` output.
 
     This is the hash the on-device extranonce roll feeds
     (``ops.merkle.make_extranonce_roll`` produces exactly this
@@ -340,7 +345,7 @@ def header_digest_dyn(
     ``tailw3`` is ``(merkle_root word 7, time word, bits word)``, the
     three header tail words before the nonce. ≡ ``double_sha256_header_
     batch(header_template(header), nonces)`` for the equivalent header
-    (tests pin them equal).
+    (tests pin them equal, batched rows included).
 
     Built on :func:`compress` (scanned on CPU, unrolled on TPU) rather
     than the symbolic partial-evaluator: with a dynamic midstate there
@@ -349,19 +354,21 @@ def header_digest_dyn(
     little-endian nonce bytes at header offset 76 read as a big-endian
     schedule word are simply ``byteswap(nonce)``.
     """
-    n = nonces.shape[0]
+    shape = nonces.shape
     tail = jnp.concatenate(
         [
-            jnp.broadcast_to(tailw3, (n, 3)),
-            byteswap32(nonces)[:, None],
+            jnp.broadcast_to(tailw3[..., None, :], shape + (3,)),
+            byteswap32(nonces)[..., None],
             jnp.broadcast_to(
                 jnp.asarray(np.array(HEADER_TAIL_PAD, dtype=np.uint32)),
-                (n, 12),
+                shape + (12,),
             ),
         ],
         axis=-1,
     )
-    state = compress(jnp.broadcast_to(midstate8, (n, 8)), tail)
+    state = compress(
+        jnp.broadcast_to(midstate8[..., None, :], shape + (8,)), tail
+    )
     block2 = jnp.concatenate(
         [
             state,
@@ -369,12 +376,12 @@ def header_digest_dyn(
                 jnp.asarray(
                     np.array([0x80000000, 0, 0, 0, 0, 0, 0, 256], dtype=np.uint32)
                 ),
-                (n, 8),
+                shape + (8,),
             ),
         ],
         axis=-1,
     )
-    return compress(jnp.broadcast_to(jnp.asarray(_H0), (n, 8)), block2)
+    return compress(jnp.broadcast_to(jnp.asarray(_H0), shape + (8,)), block2)
 
 
 # ---------------------------------------------------------------------------

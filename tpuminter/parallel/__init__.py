@@ -15,6 +15,7 @@ from tpuminter.parallel.mesh import (
     build_exact_sweep_pallas,
     build_min_fold,
     build_min_sweep_pallas,
+    build_rolled_sweep,
     build_scrypt_sweep,
     build_target_sweep,
     make_mesh,
@@ -27,5 +28,6 @@ __all__ = [
     "build_min_sweep_pallas",
     "build_exact_sweep_pallas",
     "build_candidate_sweep",
+    "build_rolled_sweep",
     "build_scrypt_sweep",
 ]

@@ -39,6 +39,7 @@ __all__ = [
     "build_min_sweep_pallas",
     "build_exact_sweep_pallas",
     "build_candidate_sweep",
+    "build_rolled_sweep",
 ]
 
 AXIS = "nonce"
@@ -423,6 +424,114 @@ def build_candidate_sweep(
     n_in = 4 if dynamic_header else 2
     sharded = _shard_map(
         per_device, mesh, in_specs=(P(),) * n_in, out_specs=(P(), P(), P())
+    )
+    return jax.jit(sharded)
+
+
+def build_rolled_sweep(
+    mesh: Mesh,
+    *,
+    width: int,
+    rows: int,
+    tiles_per_step: int = 8,
+    kernel: str = "auto",
+    cand_bits: int = 32,
+) -> Callable:
+    """Compile the pod-wide BATCHED rolled candidate sweep
+    (``tpuminter.rolled`` at slice scale): one call sweeps ``rows`` roll
+    ROWS — ``chain.rolled_tiles`` of a global window, each row up to
+    ``width`` nonces of its own extranonce's header — sharded over the
+    mesh, with the same stripe-synchronous ICI or-reduce early exit as
+    :func:`build_candidate_sweep`.
+
+    **Row striping.** ``rows`` must be a multiple of ``n_dev``; the
+    caller lays rows out device-major (``rolled.plan_tiles(...,
+    interleave=n_dev)``) so that stripe ``s`` = global-order rows
+    ``[s·n_dev, (s+1)·n_dev)``, one per device. When the or-reduce
+    fires at stripe ``s``, every row in earlier stripes was fully swept
+    and within stripe ``s`` each device swept up to its own first
+    candidate — so the ``pmin`` over per-row global offsets is the
+    lowest candidate in the covered prefix, the exact-lowest-winner
+    claim ``search.CandidateSearch`` depends on (the slab-striping
+    argument of :func:`build_candidate_sweep`, row-shaped).
+
+    Returns ``sweep(midstates (rows, 8), tailws (rows, 3), bases (rows,),
+    valids (rows,), goffs (rows,), cap_biased) -> (found_u32,
+    first_goff_u32, stripes_done_u32)`` — replicated scalars;
+    ``first_goff`` is the lowest candidate's GLOBAL offset from the
+    window start (valid iff ``found``). Rows are masked to their
+    ``valids`` exactly: an over-swept or padding row can report a
+    candidate past its valid count, which the fold drops — sound,
+    because the row's valid prefix was then swept clean. Nothing
+    job-specific is baked: one compiled program serves every job and
+    every extranonce (``cand_bits`` is the jnp engine's test seam, 32 =
+    production).
+    """
+    from tpuminter.rolled import _jnp_candidate_ok, _resolve_engine
+
+    kernel = _resolve_engine(kernel)
+    n_dev = mesh.devices.size
+    if rows % n_dev != 0:
+        raise ValueError(f"rows {rows} must be a multiple of n_dev {n_dev}")
+    rows_pd = rows // n_dev
+    umax = np.uint32(0xFFFFFFFF)
+
+    if kernel == "pallas":
+        if cand_bits != 32:
+            raise ValueError("cand_bits is a jnp-engine test seam only")
+        from tpuminter.kernels import pallas_search_candidates_hdr
+
+        def row_sweep(mid, tw, base, cap_biased):
+            cap = jax.lax.bitcast_convert_type(
+                cap_biased, jnp.uint32
+            ) ^ jnp.uint32(0x80000000)
+            return pallas_search_candidates_hdr(
+                mid, tw, base, width, tiles_per_step, cap
+            )
+    else:
+
+        def row_sweep(mid, tw, base, cap_biased):
+            nonces = base + jnp.arange(width, dtype=jnp.uint32)
+            digests = ops.header_digest_dyn(mid, tw, nonces)
+            # one source of truth for the candidate bar: un-bias the
+            # cap back to u32 and apply tpuminter.rolled's test
+            cap = jax.lax.bitcast_convert_type(
+                cap_biased, jnp.uint32
+            ) ^ jnp.uint32(0x80000000)
+            ok = _jnp_candidate_ok(digests, cap, cand_bits)
+            return ok.any().astype(jnp.uint32), jnp.argmax(ok).astype(jnp.uint32)
+
+    def per_device(mids, tails, bases, valids, goffs, cap_biased):
+        def cond(state):
+            s, found, _ = state
+            return (s < rows_pd) & (found == 0)
+
+        def body(state):
+            s, _, _ = state
+            mid = lax.dynamic_index_in_dim(mids, s, 0, keepdims=False)
+            tw = lax.dynamic_index_in_dim(tails, s, 0, keepdims=False)
+            base = lax.dynamic_index_in_dim(bases, s, 0, keepdims=False)
+            valid = lax.dynamic_index_in_dim(valids, s, 0, keepdims=False)
+            goff = lax.dynamic_index_in_dim(goffs, s, 0, keepdims=False)
+            f, off = row_sweep(mid, tw, base, cap_biased)
+            local = (f > 0) & (off < valid)
+            cand = jnp.where(local, goff + off, umax)
+            # pod-wide or-reduce over ICI: the early-exit signal; pmin
+            # folds the stripe's lowest GLOBAL candidate offset in the
+            # same round
+            found = lax.pmax(local.astype(jnp.uint32), AXIS)
+            first = lax.pmin(cand, AXIS)
+            return s + 1, found, first
+
+        s, found, first = lax.while_loop(
+            cond, body, (jnp.int32(0), jnp.uint32(0), umax)
+        )
+        return found, first, s.astype(jnp.uint32)
+
+    sharded = _shard_map(
+        per_device, mesh,
+        in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS), P(AXIS), P()),
+        out_specs=(P(), P(), P()),
     )
     return jax.jit(sharded)
 

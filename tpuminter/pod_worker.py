@@ -138,6 +138,7 @@ class PodMiner(Miner):
         exact_min: bool = False,
         spmd_leader: bool = False,
         scrypt_batch: Optional[int] = None,
+        roll_batch: int = 8,
     ):
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_dev = int(self.mesh.devices.size)
@@ -181,6 +182,15 @@ class PodMiner(Miner):
         #: module docstring of ``parallel.distributed``)
         self.spmd_leader = spmd_leader
         self._open_inner = None  # leader's in-progress chunk generator
+        #: extranonce rows per rolled dispatch (tpuminter.rolled),
+        #: rounded up to a whole number of per-device stripes; 1 = the
+        #: per-segment A/B baseline
+        self.roll_batch = roll_batch
+        #: jnp-engine candidate-bar seam (tpuminter.rolled docstring):
+        #: production 32; tests shrink it so CI-sized rolled spaces
+        #: contain candidates
+        self._cand_bits = 32
+        self._rolled_sweeps = {}  # (width, rows) -> compiled pod sweep
         self._sweep_static = None  # compiled pod programs, built lazily
         self._sweep_dyn = None
         self._scrypt_sweep = None
@@ -340,7 +350,73 @@ class PodMiner(Miner):
     # -- TARGET + extranonce rolling (pod-scale BASELINE.json:9-10) --------
 
     def _mine_rolled(self, req: Request) -> Iterator[Optional[Result]]:
+        """Pod-scale batched rolled sweep (``tpuminter.rolled``): ONE
+        ``CandidateSearch`` over global indices whose windows are
+        ``parallel.build_rolled_sweep`` dispatches — device-major
+        interleaved roll rows with stripe-synchronous ICI early exit —
+        fed by one batched roll call per window. The pod stops
+        re-entering host orchestration 2^ext_bits times per chunk;
+        ``roll_batch=1`` keeps the per-segment loop as the A/B
+        baseline."""
         assert req.header is not None and req.target is not None
+        if self.roll_batch <= 1:
+            yield from self._mine_rolled_segmented(req)
+            return
+        from tpuminter import rolled
+        from tpuminter.ops import merkle
+        from tpuminter.parallel import build_rolled_sweep
+
+        width = rolled.tile_width(req.nonce_bits, self.slab_per_device)
+        rows = -(-(self.roll_batch + 2) // self.n_dev) * self.n_dev
+        window = (rows - 2) * width
+        if window >= 1 << 32:
+            raise ValueError(
+                "rolled window (rows × width) must stay below 2^32; "
+                "shrink roll_batch or slab_per_device"
+            )
+        key = (width, rows, self.kernel, self._cand_bits)
+        if key not in self._rolled_sweeps:
+            self._rolled_sweeps[key] = build_rolled_sweep(
+                self.mesh, width=width, rows=rows,
+                tiles_per_step=self.tiles_per_step, kernel=self.kernel,
+                cand_bits=self._cand_bits,
+            )
+        sweep_prog = self._rolled_sweeps[key]
+        roll = merkle.make_extranonce_roll_batch(
+            req.header, req.coinbase_prefix, req.coinbase_suffix,
+            req.extranonce_size, req.branch,
+        )
+        cap = _biased_cap(req.target)
+        hard_end = (1 << rolled.span_bits(req)) - 1
+        n_dev = self.n_dev
+
+        def sweep(start: int, n: int):
+            plan = rolled.plan_tiles(
+                start, n, req.nonce_bits, width, rows, hard_end,
+                interleave=n_dev,
+            )
+            mids, tails = roll(
+                jnp.asarray(plan.en_hi), jnp.asarray(plan.en_lo)
+            )
+            found, first, _ = sweep_prog(
+                mids, tails, jnp.asarray(plan.bases),
+                jnp.asarray(plan.valids), jnp.asarray(plan.goffs), cap,
+            )
+            return pack_handle(found, first)
+
+        search = CandidateSearch(
+            sweep, resolve_handle, rolled.rolled_verifier(req),
+            req.lower, req.upper, slab=window, depth=self.depth,
+            domain=1 << rolled.span_bits(req),
+        )
+        for _ in search.events():
+            yield None
+        yield self._fast_result(req, search)
+
+    def _mine_rolled_segmented(self, req: Request) -> Iterator[Optional[Result]]:
+        """The pre-batching baseline (``roll_batch=1``): one scalar roll
+        + one drained ``CandidateSearch`` per extranonce segment over
+        the singleton dynamic-header pod sweep."""
         from tpuminter.ops import merkle
 
         if self._sweep_dyn is None:

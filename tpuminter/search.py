@@ -126,6 +126,14 @@ class CandidateSearch:
     resolved call (a natural heartbeat/Cancel point for the worker
     loop); when it stops, :attr:`outcome` is set.
 
+    The index domain defaults to the 32-bit header nonce space;
+    ``domain`` widens it for searches over *global* indices — a rolled
+    job's (extranonce × nonce) product space (``chain.split_global``),
+    where one search instance now spans every extranonce segment and a
+    ``sweep`` is a batched multi-roll dispatch (``tpuminter.rolled``).
+    Nothing else changes: min-fold/candidate bookkeeping is keyed by the
+    same integers ``sweep``/``verify`` speak, whatever they index.
+
     Contract note (ADVICE.md r2): when a verified win ends the search,
     up to ``depth - 1`` in-flight sweep handles above the winner are
     simply **abandoned, never resolved**. That is free for JAX async
@@ -145,13 +153,14 @@ class CandidateSearch:
         *,
         slab: int = 1 << 27,
         depth: int = 2,
+        domain: int = 1 << 32,
     ):
-        if not 0 <= lower <= upper < 1 << 32:
-            raise ValueError(f"bad range [{lower}, {upper}]")
+        if not 0 <= lower <= upper < domain:
+            raise ValueError(f"bad range [{lower}, {upper}] for domain {domain}")
         # 2^32 admits a whole-pod span (PodMiner); the single-chip
         # kernels cap their own n at 2^30 (int32 offset domain)
-        if not 1 <= slab <= 1 << 32:
-            raise ValueError("slab must be in [1, 2^32]")
+        if not 1 <= slab <= max(domain, 1 << 32):
+            raise ValueError("slab out of range")
         if depth < 1:
             raise ValueError("depth must be >= 1")
         self._sweep, self._resolve, self._verify = sweep, resolve, verify

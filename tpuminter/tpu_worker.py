@@ -44,7 +44,6 @@ from tpuminter import chain
 from tpuminter.kernels import (
     pallas_min_toy,
     pallas_search_candidates,
-    pallas_search_candidates_hdr,
     pallas_search_target,
 )
 from tpuminter.ops import sha256 as ops
@@ -112,6 +111,7 @@ class TpuMiner(Miner):
         lanes: Optional[int] = None,
         depth: int = DEFAULT_DEPTH,
         exact_min: bool = False,
+        roll_batch: int = 8,
     ):
         if jax.default_backend() == "cpu":
             raise RuntimeError(
@@ -121,6 +121,10 @@ class TpuMiner(Miner):
         self.slab = slab
         self.depth = depth
         self.exact_min = exact_min
+        #: extranonce rows per rolled dispatch (tpuminter.rolled): the
+        #: batched roll + batched dynamic-header kernel sweep many
+        #: segments per launch; 1 = the per-segment A/B baseline
+        self.roll_batch = roll_batch
         self._scrypt_delegate = None
         # scheduler hint: ask for chunks a few slabs deep
         self.lanes = lanes if lanes is not None else (slab * 4) // 16_384
@@ -181,75 +185,42 @@ class TpuMiner(Miner):
         return chain.rolled_segments(req.lower, req.upper, req.nonce_bits)
 
     def _mine_rolled_fast(self, req: Request) -> Iterator[Optional[Result]]:
-        """The production >2^32 search: per extranonce segment the roll
-        (coinbase txid → branch fold → merkle root → header midstate)
-        runs ON DEVICE and its outputs feed the dynamic-header candidate
-        kernel directly — no header bytes cross the host boundary while
-        the nonce space is swept (BASELINE.json:9-10). The host only
-        orchestrates dispatch and verifies the ~1-per-2^32 candidates."""
-        assert req.header is not None and req.target is not None
-        from tpuminter.ops import merkle
+        """The production >2^32 search: the roll (coinbase txid →
+        branch fold → merkle root → header midstate) runs ON DEVICE and
+        its outputs feed the dynamic-header candidate kernel directly —
+        no header bytes cross the host boundary while the nonce space is
+        swept (BASELINE.json:9-10). Batched (``tpuminter.rolled``): one
+        roll + one kernel launch cover ``roll_batch`` segments' worth of
+        global indices, and ONE pipelined ``CandidateSearch`` spans the
+        whole rolled range — the depth-2 buffering no longer dies at
+        segment boundaries. ``roll_batch=1`` reproduces the per-segment
+        loop (the A/B baseline)."""
+        from tpuminter import rolled
 
-        roll = merkle.make_extranonce_roll(
-            req.header, req.coinbase_prefix, req.coinbase_suffix,
-            req.extranonce_size, req.branch,
-        )
-        cb = chain.CoinbaseTemplate(
-            req.coinbase_prefix, req.coinbase_suffix, req.extranonce_size
-        )
-        hw1_cap = jnp.uint32(int(ops.target_to_words(req.target)[1]))
-        searched = 0
-        candidates = []  # (global index, hash)
-        for en, base_g, n_lo, n_hi in self._rolled_segments(req):
-            mid, tailw = roll(jnp.uint32(en >> 32), jnp.uint32(en & 0xFFFFFFFF))
-
-            prefix76: list = []  # built lazily — only a candidate needs it
-
-            def verify(nonce: int, _en=en, _cache=prefix76) -> Tuple[bool, int]:
-                if not _cache:
-                    _cache.append(
-                        chain.rolled_header(req.header, cb, req.branch, _en)
-                        .pack()[:76]
-                    )
-                h = chain.hash_to_int(
-                    chain.dsha256(_cache[0] + struct.pack("<I", nonce))
-                )
-                return h <= req.target, h
-
-            def sweep(base: int, n: int, _mid=mid, _tailw=tailw):
-                found, off = pallas_search_candidates_hdr(
-                    _mid, _tailw, jnp.uint32(base), n, 8, hw1_cap
-                )
-                return pack_handle(found, off)
-
-            search = CandidateSearch(
-                sweep, resolve_handle, verify, n_lo, n_hi,
-                slab=self.slab, depth=self.depth,
-            )
-            for _ in search.events():
-                yield None
-            out = search.outcome
-            searched += out.searched
-            candidates += [(base_g | n, h) for n, h in out.candidates]
-            if out.found:
-                yield Result(
-                    req.job_id, req.mode, base_g | out.nonce, out.hash_value,
-                    found=True, searched=searched, chunk_id=req.chunk_id,
-                )
-                return
-        best = min(((h, g) for g, h in candidates), default=None)
-        hash_value, nonce = best if best else (MIN_UNTRACKED, req.lower)
-        yield Result(
-            req.job_id, req.mode, nonce, hash_value, found=False,
-            searched=searched, chunk_id=req.chunk_id,
+        yield from rolled.mine_rolled_fast(
+            req, slab=self.slab, depth=self.depth,
+            roll_batch=self.roll_batch, engine="pallas",
         )
 
     def _mine_rolled_tracking(self, req: Request) -> Iterator[Optional[Result]]:
         """Rolled search at toy-easy targets (≥ 2^224, where the
-        candidate test is not a necessary condition): segment loop over
-        the exact tracking kernel with host-rolled headers. Correctness
-        path only — real difficulties take :meth:`_mine_rolled_fast`."""
+        candidate test is not a necessary condition): exact tracking,
+        CpuMiner-compatible. Default: the batched dynamic-header sweep
+        (``rolled.mine_rolled_tracking`` — one compile for every
+        extranonce AND every job, where the per-segment loop below
+        recompiles ``pallas_search_target`` per rolled header, ~20-40 s
+        each through the tunnel). ``roll_batch=1`` keeps that loop as
+        the baseline. Correctness path only — real difficulties take
+        :meth:`_mine_rolled_fast`."""
         assert req.target is not None
+        if self.roll_batch > 1:
+            from tpuminter import rolled
+
+            yield from rolled.mine_rolled_tracking(
+                req, width_cap=min(self.slab, 1 << 16), depth=self.depth,
+                roll_batch=self.roll_batch,
+            )
+            return
         cb = chain.CoinbaseTemplate(
             req.coinbase_prefix, req.coinbase_suffix, req.extranonce_size
         )

@@ -520,19 +520,25 @@ def _build_miner(
     slab: Optional[int] = None,
     depth: Optional[int] = None,
     spmd_leader: bool = False,
+    roll_batch: Optional[int] = None,
 ) -> Miner:
     """Backend registry for the CLI; device backends import lazily.
 
-    ``exact_min``/``slab``/``depth`` tune the TPU backend (ADVICE.md r2:
-    fleets needing CpuMiner-compatible exhausted-range minima opt in via
-    ``--exact-min``); the other backends ignore them.
+    ``exact_min``/``slab``/``depth``/``roll_batch`` tune the device
+    backends (ADVICE.md r2: fleets needing CpuMiner-compatible
+    exhausted-range minima opt in via ``--exact-min``; ``--roll-batch
+    1`` pins the per-segment rolled baseline); the other backends
+    ignore them.
     """
     if backend == "cpu":
         return CpuMiner()
     if backend == "jax":
         from tpuminter.jax_worker import JaxMiner
 
-        return JaxMiner()
+        kwargs = {}
+        if roll_batch is not None:
+            kwargs["roll_batch"] = roll_batch
+        return JaxMiner(**kwargs)
     if backend == "tpu":
         from tpuminter.tpu_worker import TpuMiner
 
@@ -541,6 +547,8 @@ def _build_miner(
             kwargs["slab"] = slab
         if depth is not None:
             kwargs["depth"] = depth
+        if roll_batch is not None:
+            kwargs["roll_batch"] = roll_batch
         return TpuMiner(**kwargs)
     if backend == "pod":
         from tpuminter.pod_worker import PodMiner
@@ -550,6 +558,8 @@ def _build_miner(
             kwargs["slab_per_device"] = slab
         if depth is not None:
             kwargs["depth"] = depth
+        if roll_batch is not None:
+            kwargs["roll_batch"] = roll_batch
         return PodMiner(**kwargs)
     if backend == "native":
         from tpuminter.native_worker import NativeMiner
@@ -594,6 +604,14 @@ def main(argv: Optional[list] = None) -> None:
     parser.add_argument(
         "--depth", type=int, default=None,
         help="tpu backend: device calls kept in flight (default 2)",
+    )
+    parser.add_argument(
+        "--roll-batch", type=int, default=None,
+        help="jax/tpu/pod backends: extranonce rows per rolled dispatch "
+        "(default 8) — one batched roll + one batched sweep cover that "
+        "many segments' worth of indices per device call; 1 reproduces "
+        "the per-segment loop (the A/B baseline, README 'Rolled "
+        "sweeps')",
     )
     parser.add_argument(
         "--profile", metavar="DIR", default=None,
@@ -655,13 +673,14 @@ def main(argv: Optional[list] = None) -> None:
 
                 follower_loop(_build_miner(
                     args.backend, exact_min=args.exact_min, slab=args.slab,
-                    depth=args.depth,
+                    depth=args.depth, roll_batch=args.roll_batch,
                 ))
                 return
             spmd_leader = True
     miner = _build_miner(
         args.backend, exact_min=args.exact_min, slab=args.slab,
         depth=args.depth, spmd_leader=spmd_leader,
+        roll_batch=args.roll_batch,
     )
     if args.profile:
         try:
