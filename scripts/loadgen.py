@@ -87,6 +87,7 @@ sys.path.insert(0, __import__("os").path.dirname(
 ))
 
 from tpuminter import chain  # noqa: E402
+from tpuminter.analysis import affinity  # noqa: E402
 from tpuminter.coordinator import Coordinator  # noqa: E402
 from tpuminter.lsp import (  # noqa: E402
     LspClient,
@@ -774,6 +775,7 @@ async def run_crash(
     loops: int = 1,
     io_batch=None,
     journal_mode: str = "writer",
+    loop_affinity: bool = False,
 ) -> dict:
     """The crash-recovery drill: journaled coordinator + resilient
     fleet; kill the coordinator mid-burst (socket closed, no drain,
@@ -787,6 +789,13 @@ async def run_crash(
     """
     import shutil
 
+    affinity_was_on = affinity.enabled()
+    if loop_affinity:
+        # runtime race detector (tpuminter.analysis.affinity): stamp
+        # coordinator/journal/replication objects and record every
+        # cross-loop mutation across the whole drill
+        affinity.reset()
+        affinity.enable()
     tmpdir = None
     if journal_path is None:
         tmpdir = tempfile.mkdtemp(prefix="tpuminter-loadgen-")
@@ -926,6 +935,16 @@ async def run_crash(
         await asyncio.gather(serve, return_exceptions=True)
         if state["coord"] is not None:
             await state["coord"].close()
+        if loop_affinity:
+            # harvest after teardown so close-path mutations count too
+            vio = affinity.violations()
+            try:
+                metrics["affinity_violations"] = len(vio)
+                metrics["affinity_sample"] = vio[:8]
+            except NameError:
+                pass  # drill died before the metrics dict existed
+            if not affinity_was_on:
+                affinity.disable()
         if tmpdir is not None:
             shutil.rmtree(tmpdir, ignore_errors=True)
 
@@ -952,6 +971,12 @@ def crash_check(metrics: dict) -> list:
             "fleet did not resume within 10 s of the restart: "
             f"{metrics.get('restart_to_first_assign_ms')} ms"
         )
+    if metrics.get("affinity_violations", 0) > 0:
+        bad.append(
+            f"{metrics['affinity_violations']} cross-loop mutation(s) "
+            f"caught by the runtime affinity detector: "
+            f"{metrics.get('affinity_sample')}"
+        )
     return bad
 
 
@@ -974,6 +999,7 @@ async def run_failover(
     replica_ack: bool = True,
     loops: int = 1,
     io_batch=None,
+    loop_affinity: bool = False,
 ) -> dict:
     """The replicated-coordinator drill: primary journals AND ships its
     WAL to a live hot standby; mid-burst the primary machine "dies"
@@ -994,6 +1020,10 @@ async def run_failover(
 
     from tpuminter.replication import ReplicationStandby
 
+    affinity_was_on = affinity.enabled()
+    if loop_affinity:
+        affinity.reset()
+        affinity.enable()
     tmpdir = tempfile.mkdtemp(prefix="tpuminter-failover-")
     primary_wal = os.path.join(tmpdir, "primary.wal")
     standby_wal = os.path.join(tmpdir, "standby.wal")
@@ -1149,6 +1179,15 @@ async def run_failover(
             await coord2.close()
         elif not standby.promoted:
             await standby.close()
+        if loop_affinity:
+            vio = affinity.violations()
+            try:
+                metrics["affinity_violations"] = len(vio)
+                metrics["affinity_sample"] = vio[:8]
+            except NameError:
+                pass  # drill died before the metrics dict existed
+            if not affinity_was_on:
+                affinity.disable()
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
@@ -1181,6 +1220,12 @@ def failover_check(metrics: dict, params: Params = FAST) -> list:
             f"takeover took {metrics.get('takeover_ms')} ms, over one "
             f"loss horizon ({horizon_ms} ms): the promoted standby did "
             f"not pick the fleet up promptly"
+        )
+    if metrics.get("affinity_violations", 0) > 0:
+        bad.append(
+            f"{metrics['affinity_violations']} cross-loop mutation(s) "
+            f"caught by the runtime affinity detector: "
+            f"{metrics.get('affinity_sample')}"
         )
     return bad
 
@@ -1279,6 +1324,12 @@ def main(argv=None) -> int:
         "than the saved fsyncs are worth, PERF.md Round 11); 'on' is "
         "the knob for slow-disk deployments and A/B runs",
     )
+    parser.add_argument(
+        "--loop-affinity", action="store_true",
+        help="enable the runtime loop-affinity race detector "
+             "(tpuminter.analysis.affinity) for the crash/failover "
+             "drills; --smoke then fails on any cross-loop mutation",
+    )
     parser.add_argument("--json", action="store_true", help="JSON output")
     args = parser.parse_args(argv)
     knobs = dict(
@@ -1297,7 +1348,7 @@ def main(argv=None) -> int:
             args.miners, max(2, args.clients // 2),
             chunk_size=args.chunk_size,
             pre=min(args.duration, 2.0), post=args.duration,
-            replica_ack=True, **knobs,
+            replica_ack=True, loop_affinity=args.loop_affinity, **knobs,
         ))
         print(json.dumps(metrics) if args.json else
               "\n".join(f"{k}: {v}" for k, v in metrics.items()))
@@ -1312,7 +1363,8 @@ def main(argv=None) -> int:
             args.miners, max(2, args.clients // 2),
             journal_path=args.journal, chunk_size=args.chunk_size,
             pre=min(args.duration, 2.0), post=args.duration,
-            journal_mode=args.journal_mode, **knobs,
+            journal_mode=args.journal_mode,
+            loop_affinity=args.loop_affinity, **knobs,
         ))
         print(json.dumps(metrics) if args.json else
               "\n".join(f"{k}: {v}" for k, v in metrics.items()))

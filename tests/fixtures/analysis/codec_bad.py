@@ -1,0 +1,29 @@
+"""True-positive fixture: a codec table violating every PR 4 invariant.
+
+Two kinds share a tag, one tag is 0x7B (the JSON sniff byte), two
+fixed-length kinds share a total packed length, nothing is sealed with
+a CRC, one layout buries the tag mid-record, and a u64 field is packed
+unguarded. Parsed by tests/test_analysis.py, never imported.
+"""
+
+import struct
+
+_TAG_PING = 0xC1
+_PING = struct.Struct("<BQ")
+
+_TAG_PONG = 0xC1            # duplicate tag
+_PONG = struct.Struct("<BI")
+
+_TAG_BRACE = 0x7B           # collides with the JSON sniff byte
+_BRACE = struct.Struct("<BII")
+
+_TAG_ECHO = 0xC3            # same calcsize as _PING: length collision
+_ECHO = struct.Struct("<BII")
+
+_TAG_TAIL = 0xC4            # tag byte not first
+_TAIL = struct.Struct("<QB")
+
+
+def encode_ping(nonce: int) -> bytes:
+    # u64 field packed with no _U64 range guard
+    return _PING.pack(_TAG_PING, nonce)

@@ -1,0 +1,41 @@
+"""True-positive fixture: a cross-loop shard mutation outside the
+seams.
+
+Reconstructs the race class the PR 6 ownership rules exist to prevent:
+the control loop pokes attributes on a shard-homed object directly
+instead of hopping through ``call_soon_threadsafe``. Parsed by
+tests/test_analysis.py, never imported.
+"""
+
+import asyncio
+import threading
+
+
+class _Worker:
+    def __init__(self, index):
+        self.index = index
+        self.loop = None
+        self.backlog = 0
+
+
+class Group:
+    def __init__(self, n):
+        self._shards = []
+        for k in range(n):
+            worker = _Worker(k)
+            t = threading.Thread(target=self._shard_thread, args=(worker,))
+            t.start()
+            self._shards.append(worker)
+
+    def _shard_thread(self, worker):
+        worker.loop = asyncio.new_event_loop()
+        worker.loop.run_forever()
+
+    def rebalance(self):
+        # control loop writing a shard-homed attribute: the bug
+        for worker in self._shards:
+            worker.backlog = 0
+
+    def shutdown(self):
+        for worker in self._shards:
+            worker.loop.call_soon_threadsafe(worker.loop.stop)

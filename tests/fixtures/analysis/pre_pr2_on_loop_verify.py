@@ -1,0 +1,35 @@
+"""True-positive fixture: the pre-PR-2 on-loop verify.
+
+Reconstructs the bug class PR 2 fixed — the coordinator settled every
+scrypt result inline on the event loop (~301 µs each), plus the classic
+``time.sleep``-in-a-coroutine and the fsync-on-the-loop that PR 3's
+adaptive seam removed. Parsed by tests/test_analysis.py, never
+imported; the names mirror the real coordinator so the checker's
+intra-module propagation is exercised (async serve → sync handler →
+blocking call).
+"""
+
+import os
+import time
+
+from tpuminter import chain
+
+
+class Coordinator:
+    async def serve(self):
+        while True:
+            msg = await self._next()
+            self._on_result(msg)
+            time.sleep(0.001)  # "pacing"
+
+    def _on_result(self, msg):
+        # inline memory-hard verify on the loop: the PR 2 bug
+        digest = chain.scrypt_hash(msg.header)
+        self._settle(msg, digest)
+
+    def _settle(self, msg, digest):
+        self._journal.append(msg, digest)
+        os.fsync(self._journal_fd)
+
+    async def _next(self):
+        return await self._queue.get()

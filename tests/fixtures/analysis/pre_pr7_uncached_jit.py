@@ -1,0 +1,34 @@
+"""True-positive fixture: the pre-PR-7 uncached jit.
+
+Reconstructs the bug class PR 7 fixed — a fresh ``jax.jit`` wrapper
+(and a fresh ``pl.pallas_call``) constructed per dispatch, so every job
+paid the full re-trace (~0.6 s measured). Also carries the sibling
+hazard: a list literal passed to an ``lru_cache``'d factory. Parsed by
+tests/test_analysis.py, never imported.
+"""
+
+from functools import lru_cache
+
+import jax
+import jax.experimental.pallas as pl
+
+
+def sweep_job(header, grid):
+    # rebuilt per call: empty trace cache every time (the PR 7 bug)
+    sweep = jax.jit(lambda h: h * 2)
+    kernel = pl.pallas_call(_body, grid=grid)
+    return sweep(kernel(header))
+
+
+@lru_cache(maxsize=8)
+def build_sweep(lanes, widths):
+    return jax.jit(lambda h: h * lanes)
+
+
+def dispatch(header):
+    # unhashable argument defeats the factory cache at runtime
+    return build_sweep(8, [128, 256])(header)
+
+
+def _body(ref, out):
+    out[...] = ref[...]

@@ -1,0 +1,355 @@
+"""Tier-1 gate for the static-analysis suite (ISSUE 9).
+
+Three layers: (1) the whole tree must be clean under the committed
+allowlist — any new finding, or any allowlist entry that stopped
+matching, fails CI; (2) the fixture corpus under
+``tests/fixtures/analysis/`` reconstructs each checker's historical bug
+class and must keep being flagged — the suite is pinned to its reason
+for existing; (3) the runtime loop-affinity detector catches a seeded
+deliberate cross-loop mutation and stays silent for the sanctioned
+executor seam.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from tpuminter.analysis import affinity
+from tpuminter.analysis.core import (
+    Allowlist,
+    parse_module,
+    run_project,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join("tests", "fixtures", "analysis")
+
+
+def _fixture_findings(name, checkers):
+    src = parse_module(REPO_ROOT, os.path.join(FIXTURES, name))
+    findings = []
+    from tpuminter.analysis import (
+        codec_conformance,
+        loop_blocker,
+        retrace,
+        thread_seam,
+    )
+    registry = {
+        "loop-blocker": loop_blocker,
+        "retrace-hazard": retrace,
+        "thread-seam": thread_seam,
+        "codec-conformance": codec_conformance,
+    }
+    for checker in checkers:
+        findings.extend(registry[checker].check_module(src))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# (1) the tree is clean under the committed allowlist
+# ---------------------------------------------------------------------------
+
+def test_tree_clean_under_allowlist():
+    report = run_project(REPO_ROOT)
+    assert report.clean, "\n" + "\n".join(report.render())
+    # the allowlist is doing real work (first-run findings were all
+    # justified, not deleted) and every entry carries a reason
+    assert report.suppressed, "allowlist suppressed nothing — stale suite?"
+    for entry in Allowlist.load().entries:
+        assert entry["reason"].strip()
+
+
+def test_check_cli_json_mode():
+    proc = subprocess.run(
+        [sys.executable, os.path.join("scripts", "check.py"),
+         "--json", "--no-ruff"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is True
+    assert payload["findings"] == []
+    assert payload["suppressed"]
+    assert payload["stale_allowlist_entries"] == []
+
+
+def test_stale_allowlist_entry_is_reported():
+    stale = Allowlist([{
+        "checker": "loop-blocker", "path": "tpuminter/nowhere.py",
+        "qualname": "gone", "symbol": "os.fsync",
+        "reason": "this code was deleted long ago",
+    }])
+    report = run_project(REPO_ROOT, allowlist=stale)
+    assert not report.clean
+    assert len(report.stale_entries) == 1
+
+
+def test_allowlist_rejects_empty_reason():
+    with pytest.raises(ValueError):
+        Allowlist([{
+            "checker": "loop-blocker", "path": "x.py",
+            "qualname": "f", "symbol": "open", "reason": "  ",
+        }])
+
+
+# ---------------------------------------------------------------------------
+# (2) the fixture corpus: each checker still catches its bug class
+# ---------------------------------------------------------------------------
+
+def test_loop_blocker_catches_pre_pr2_on_loop_verify():
+    findings = _fixture_findings(
+        "pre_pr2_on_loop_verify.py", ["loop-blocker"]
+    )
+    symbols = {f.symbol for f in findings}
+    assert "chain.scrypt_hash" in symbols     # the PR 2 bug itself
+    assert "time.sleep" in symbols
+    assert "os.fsync" in symbols              # propagated two hops deep
+    quals = {f.qualname for f in findings if f.symbol == "os.fsync"}
+    assert "Coordinator._settle" in quals
+
+
+def test_retrace_catches_pre_pr7_uncached_jit():
+    findings = _fixture_findings("pre_pr7_uncached_jit.py", ["retrace-hazard"])
+    symbols = {f.symbol for f in findings}
+    assert "jax.jit" in symbols
+    assert "pl.pallas_call" in symbols
+    # the cached factory itself must NOT be flagged...
+    assert not any(f.qualname == "build_sweep" for f in findings)
+    # ...but the list literal passed to it must be
+    assert any(
+        f.qualname == "dispatch" and "unhashable" in f.message
+        for f in findings
+    )
+
+
+def test_thread_seam_catches_cross_loop_write():
+    findings = _fixture_findings("cross_loop_write.py", ["thread-seam"])
+    assert any(
+        f.qualname == "Group.rebalance" and f.symbol == "worker.backlog"
+        for f in findings
+    )
+    # seam-respecting code stays quiet: the thread body owns its writes,
+    # shutdown hops via call_soon_threadsafe
+    assert not any(f.qualname == "Group._shard_thread" for f in findings)
+    assert not any(f.qualname == "Group.shutdown" for f in findings)
+
+
+def test_codec_conformance_catches_bad_table():
+    findings = _fixture_findings("codec_bad.py", ["codec-conformance"])
+    violations = {f.symbol.split(":", 1)[0] for f in findings if ":" in f.symbol}
+    assert "duplicate-tag" in violations
+    assert "json-collision" in violations
+    assert "length-collision" in violations
+    assert "missing-crc" in violations
+    assert "tag-not-first" in violations
+    assert any(
+        f.qualname == "encode_ping" and f.symbol == "_PING"
+        for f in findings
+    )
+
+
+# ---------------------------------------------------------------------------
+# (3) runtime loop-affinity detector
+# ---------------------------------------------------------------------------
+
+class _Victim:
+    def __init__(self):
+        self.counter = 0
+
+
+def _run_loop_in_thread(coro_fn, *args):
+    """Run ``coro_fn(*args)`` inside a fresh loop on a fresh thread."""
+    box = {}
+
+    def runner():
+        loop = asyncio.new_event_loop()
+        try:
+            box["result"] = loop.run_until_complete(coro_fn(*args))
+        except BaseException as exc:  # noqa: BLE001 — re-raised below
+            box["error"] = exc
+        finally:
+            loop.close()
+
+    t = threading.Thread(target=runner)
+    t.start()
+    t.join(30)
+    if "error" in box:
+        raise box["error"]
+    return box.get("result")
+
+
+@pytest.fixture
+def detector():
+    affinity.reset()
+    affinity.enable()
+    yield affinity
+    affinity.disable()
+    affinity.reset()
+
+
+def test_affinity_catches_seeded_cross_loop_mutation(detector):
+    victim = _Victim()
+
+    async def owner_side():
+        affinity.stamp(victim)
+        victim.counter += 1  # own-loop write: fine
+
+    async def intruder_side():
+        victim.counter = 99  # deliberate cross-loop mutation
+
+    _run_loop_in_thread(owner_side)
+    _run_loop_in_thread(intruder_side)
+    bad = affinity.violations()
+    assert len(bad) == 1
+    assert bad[0]["cls"] == "_Victim"
+    assert bad[0]["attr"] == "counter"
+    assert victim.counter == 99  # non-strict mode records, never alters
+
+
+def test_affinity_strict_raises(detector):
+    affinity.enable(strict=True)
+    victim = _Victim()
+
+    async def owner_side():
+        affinity.stamp(victim)
+
+    async def intruder_side():
+        victim.counter = 7
+
+    _run_loop_in_thread(owner_side)
+    with pytest.raises(affinity.LoopAffinityError):
+        _run_loop_in_thread(intruder_side)
+
+
+def test_affinity_exempts_executor_threads(detector):
+    victim = _Victim()
+
+    async def owner_side():
+        affinity.stamp(victim)
+
+        def executor_write():
+            victim.counter = 42  # sanctioned offload: no loop running
+
+        await asyncio.get_running_loop().run_in_executor(
+            None, executor_write
+        )
+
+    _run_loop_in_thread(owner_side)
+    assert affinity.violations() == []
+    assert victim.counter == 42
+
+
+def test_affinity_rebind_transfers_ownership(detector):
+    victim = _Victim()
+
+    async def owner_side():
+        affinity.stamp(victim)
+
+    async def adopter_side():
+        affinity.rebind(victim)
+        victim.counter = 5  # now a home write
+
+    _run_loop_in_thread(owner_side)
+    _run_loop_in_thread(adopter_side)
+    assert affinity.violations() == []
+
+
+def test_affinity_disabled_is_inert():
+    affinity.disable()
+    affinity.reset()
+    victim = _Victim()
+    assert affinity.stamp(victim) is victim
+    assert type(victim) is _Victim  # no class swap when disabled
+    victim.counter = 1
+    assert affinity.violations() == []
+
+
+# ---------------------------------------------------------------------------
+# (4) deterministic mirror of the hypothesis table properties
+# (tests/test_properties.py carries the shrinking versions; this image
+# lacks hypothesis, so tier-1 drives the same oracle with a seeded RNG)
+# ---------------------------------------------------------------------------
+
+import random
+
+from tpuminter.analysis.codec_conformance import (
+    JSON_SNIFF_BYTE,
+    check_table,
+    struct_size,
+)
+
+
+def _random_table(rng):
+    kinds = []
+    for i in range(rng.randint(1, 8)):
+        body = "".join(
+            rng.choice("BHIQ") for _ in range(rng.randint(1, 5))
+        )
+        kinds.append({
+            "name": f"_K{i}",
+            "module": rng.choice(["a.py", "b.py"]),
+            "line": i + 1,
+            "tag": rng.choice([None, rng.randint(0, 255), 0x7B]),
+            "fmt": "<" + body,
+            "variable": rng.random() < 0.3,
+            "has_crc": rng.random() < 0.7,
+        })
+    return kinds
+
+
+def _oracle(kinds):
+    expected = set()
+    by_tag = {}
+    for k in kinds:
+        if k["tag"] is not None:
+            by_tag.setdefault(k["tag"], []).append(k)
+    for tag, group in by_tag.items():
+        for k in group[1:]:
+            expected.add(("duplicate-tag", k["name"]))
+        if tag == JSON_SNIFF_BYTE:
+            for k in group:
+                expected.add(("json-collision", k["name"]))
+    by_mod = {}
+    for k in kinds:
+        if k["fmt"] and not k["variable"]:
+            by_mod.setdefault(k["module"], []).append(k)
+    for group in by_mod.values():
+        by_size = {}
+        for k in group:
+            size = struct_size(k["fmt"])
+            if size is not None:
+                by_size.setdefault(size, []).append(k)
+        for clash in by_size.values():
+            for k in sorted(clash, key=lambda k: k["line"])[1:]:
+                expected.add(("length-collision", k["name"]))
+    for k in kinds:
+        if k["tag"] is not None and not k["fmt"][1:].startswith("B"):
+            expected.add(("tag-not-first", k["name"]))
+        if not k["has_crc"]:
+            expected.add(("missing-crc", k["name"]))
+    return expected
+
+
+def test_codec_table_core_matches_oracle_seeded():
+    rng = random.Random(0x9E3779B9)
+    for _ in range(400):
+        kinds = _random_table(rng)
+        got = {(v["violation"], v["kind"]) for v in check_table(kinds)}
+        assert got == _oracle(kinds), kinds
+
+
+def test_codec_table_core_accepts_repaired_tables_seeded():
+    rng = random.Random(0xC0FFEE)
+    for _ in range(100):
+        kinds = _random_table(rng)
+        for i, k in enumerate(kinds):
+            k["tag"] = 0xA0 + i
+            k["fmt"] = "<B" + "B" * i
+            k["variable"] = False
+            k["has_crc"] = True
+        assert check_table(kinds) == []

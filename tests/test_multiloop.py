@@ -384,15 +384,21 @@ def test_two_loop_smoke_no_losses_no_cross_shard_duplication():
 def test_two_loop_crash_drill_exactly_once():
     """kill -9 a 2-loop coordinator mid-burst (single-writer journal),
     restart it with 2 loops on the same port: every submitted request
-    answered exactly once."""
+    answered exactly once. Runs under the runtime loop-affinity race
+    detector (ISSUE 9): every coordinator/journal/replication mutation
+    across the burst, kill, and recovery is checked against its owning
+    loop, and one cross-loop write fails the drill."""
     metrics = run(
-        loadgen.run_crash(16, 2, pre=1.0, post=2.0, loops=2),
+        loadgen.run_crash(
+            16, 2, pre=1.0, post=2.0, loops=2, loop_affinity=True
+        ),
         timeout=120.0,
     )
     assert loadgen.crash_check(metrics) == [], metrics
     assert metrics["answers_duplicated"] == 0
     assert metrics["answers_lost"] == 0
     assert metrics["loops"] == 2
+    assert metrics["affinity_violations"] == 0, metrics["affinity_sample"]
 
 
 def test_two_loop_crash_drill_segments_mode():

@@ -603,3 +603,94 @@ def test_walbatch_envelope_corruption_raises_never_misparses(
     wire[i] ^= data.draw(st.integers(1, 255))
     with pytest.raises(ProtocolError):
         decode_msg(bytes(wire))
+
+
+# ---------------------------------------------------------------------------
+# codec-conformance checker (ISSUE 9): the static analyzer's table core
+# must flag random kind tables iff they violate the PR 4 invariants
+# ---------------------------------------------------------------------------
+
+from tpuminter.analysis.codec_conformance import (  # noqa: E402
+    JSON_SNIFF_BYTE,
+    check_table,
+    struct_size,
+)
+
+_fmt_field = st.sampled_from(list("BHIQ"))
+
+
+@st.composite
+def _kind_tables(draw):
+    n = draw(st.integers(1, 8))
+    kinds = []
+    for i in range(n):
+        body = "".join(draw(st.lists(_fmt_field, min_size=1, max_size=5)))
+        kinds.append({
+            "name": f"_K{i}",
+            "module": draw(st.sampled_from(["a.py", "b.py"])),
+            "line": i + 1,  # unique: the length-collision tiebreak
+            "tag": draw(st.one_of(st.none(), st.integers(0, 255))),
+            "fmt": "<" + body,
+            "variable": draw(st.booleans()),
+            "has_crc": draw(st.booleans()),
+        })
+    return kinds
+
+
+def _expected_violations(kinds):
+    """Independent oracle for check_table: the set of
+    ``(violation, kind_name)`` pairs the invariants demand."""
+    expected = set()
+    by_tag = {}
+    for k in kinds:
+        if k["tag"] is not None:
+            by_tag.setdefault(k["tag"], []).append(k)
+    for tag, group in by_tag.items():
+        for k in group[1:]:
+            expected.add(("duplicate-tag", k["name"]))
+        if tag == JSON_SNIFF_BYTE:
+            for k in group:
+                expected.add(("json-collision", k["name"]))
+    by_mod = {}
+    for k in kinds:
+        if k["fmt"] and not k["variable"]:
+            by_mod.setdefault(k["module"], []).append(k)
+    for group in by_mod.values():
+        by_size = {}
+        for k in group:
+            size = struct_size(k["fmt"])
+            if size is not None:
+                by_size.setdefault(size, []).append(k)
+        for clash in by_size.values():
+            for k in sorted(clash, key=lambda k: k["line"])[1:]:
+                expected.add(("length-collision", k["name"]))
+    for k in kinds:
+        body = k["fmt"][1:]
+        if k["tag"] is not None and not body.startswith("B"):
+            expected.add(("tag-not-first", k["name"]))
+        if not k["has_crc"]:
+            expected.add(("missing-crc", k["name"]))
+    return expected
+
+
+@settings(max_examples=200)
+@given(_kind_tables())
+def test_codec_checker_flags_iff_invariant_violated(kinds):
+    """Soundness AND completeness of the table core: a random kind
+    table is flagged exactly where the distinct-length / CRC / tag
+    invariants are broken — no false alarms, no misses."""
+    got = {(v["violation"], v["kind"]) for v in check_table(kinds)}
+    assert got == _expected_violations(kinds)
+
+
+@settings(max_examples=60)
+@given(_kind_tables())
+def test_codec_checker_clean_table_stays_clean(kinds):
+    """Repairing every violation yields a table the checker accepts:
+    distinct tags, distinct lengths, CRC everywhere, tag byte first."""
+    for i, k in enumerate(kinds):
+        k["tag"] = 0xA0 + i              # distinct, never 0x7B
+        k["fmt"] = "<B" + "B" * i        # distinct sizes, tag first
+        k["variable"] = False
+        k["has_crc"] = True
+    assert check_table(kinds) == []

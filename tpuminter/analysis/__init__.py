@@ -1,0 +1,40 @@
+"""Project-specific static analysis + runtime race detection (ISSUE 9).
+
+Every serious bug this repo has shipped or fixed falls into a small set
+of recurring, mechanically-detectable classes:
+
+- **loop-blocker** (PR 2's 301 µs on-loop scrypt verify, PR 3's journal
+  fsync war): blocking calls reachable from ``async def`` / event-loop
+  callbacks that never went through the executor seams.
+- **retrace-hazard** (PR 7's measured ~0.6 s/job re-trace tax): fresh
+  ``jax.jit`` / ``pallas_call`` wrappers constructed per call instead of
+  behind an ``lru_cache``-style memoized factory.
+- **thread-seam** (PR 6): attribute writes on cross-loop-shared objects
+  outside the sanctioned ``multiloop`` seams (``_Handoff``,
+  ``_JournalProxy``, ``call_soon_threadsafe``).
+- **codec-conformance** (PR 4): the wire/journal binary-codec
+  invariants — distinct total length per tag, CRC on every binary kind,
+  u64-guarded fields — re-proved from the struct tables themselves
+  instead of only by golden tests.
+
+The static half lives in the ``*_checker`` submodules and runs via
+``scripts/check.py`` (and tier-1's ``tests/test_analysis.py``) against
+the committed, per-finding-justified ``allowlist.json``. The runtime
+half (:mod:`tpuminter.analysis.affinity`) is the thread-seam checker's
+dynamic twin: ``TPUMINTER_LOOP_AFFINITY=1`` stamps owning-loop identity
+on coordinator/journal/replication objects and flags every mutation
+arriving from a *different* event loop's thread.
+
+This package is imported by production modules only for the (lazily
+cheap) ``affinity`` hooks — keep this ``__init__`` free of checker
+imports so the hot path never pays for ``ast`` machinery.
+"""
+
+from tpuminter.analysis.core import (  # noqa: F401
+    Allowlist,
+    Finding,
+    default_allowlist_path,
+    run_project,
+)
+
+__all__ = ["Allowlist", "Finding", "default_allowlist_path", "run_project"]

@@ -1,0 +1,161 @@
+"""Runtime loop-affinity race detector — the thread-seam checker's
+dynamic twin.
+
+``TPUMINTER_LOOP_AFFINITY=1`` (or :func:`enable`) turns on the
+instrumentation; production call sites then :func:`stamp` an object at
+construction and :func:`rebind` it at the sanctioned ownership-transfer
+seams (the multi-loop coordinator hands the writer journal to shard 0's
+loop after control-loop recovery). Stamping swaps the instance's class
+for a cached one-off subclass whose ``__setattr__`` compares the
+writing thread against the stamped owner on *every* mutation.
+
+The violation rule mirrors the project's actual memory model, not a
+naive "owner thread only" assertion:
+
+- writes from the owner thread: fine (the common case, zero bookkeeping);
+- writes from another thread that is NOT running an event loop: fine —
+  that is the executor seam (``Journal._write_sync`` bumps ``self.size``
+  from the flush executor by design; the loop awaits the future, so the
+  write is ordered);
+- writes from another thread that IS running an event loop: a
+  cross-loop mutation — exactly the race class PR 6's seams exist to
+  prevent. Recorded (and raised, in ``strict`` mode).
+
+When disabled, :func:`stamp` returns immediately — production pays one
+module-global read per constructed object and nothing per mutation.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+try:  # running-loop probe that returns None instead of raising
+    from asyncio import _get_running_loop
+except ImportError:  # pragma: no cover
+    import asyncio
+
+    def _get_running_loop():
+        try:
+            return asyncio.get_running_loop()
+        except RuntimeError:
+            return None
+
+__all__ = [
+    "LoopAffinityError",
+    "enable",
+    "disable",
+    "enabled",
+    "rebind",
+    "reset",
+    "stamp",
+    "violations",
+]
+
+_OWNER = "_affinity_owner_ident"
+
+_enabled = False
+_strict = False
+_lock = threading.Lock()
+_violations: List[dict] = []
+_instrumented: Dict[type, type] = {}
+
+
+class LoopAffinityError(AssertionError):
+    """A cross-loop mutation, raised only in strict mode."""
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(strict: bool = False) -> None:
+    global _enabled, _strict
+    _enabled = True
+    _strict = strict
+
+
+def disable() -> None:
+    global _enabled, _strict
+    _enabled = False
+    _strict = False
+
+
+def reset() -> None:
+    with _lock:
+        _violations.clear()
+
+
+def violations() -> List[dict]:
+    with _lock:
+        return list(_violations)
+
+
+def _record(obj: object, name: str, owner: int, writer: int) -> None:
+    entry = {
+        "cls": type(obj).__name__,
+        "attr": name,
+        "owner_ident": owner,
+        "writer_ident": writer,
+        "writer_thread": threading.current_thread().name,
+    }
+    with _lock:
+        _violations.append(entry)
+    if _strict:
+        raise LoopAffinityError(
+            f"cross-loop mutation: {entry['cls']}.{name} owned by thread "
+            f"{owner}, written from loop thread {writer} "
+            f"({entry['writer_thread']})"
+        )
+
+
+def _instrument(cls: type) -> type:
+    sub = _instrumented.get(cls)
+    if sub is not None:
+        return sub
+
+    def __setattr__(self, name, value):  # noqa: N807
+        owner = self.__dict__.get(_OWNER)
+        if owner is not None and not name.startswith("_affinity_"):
+            writer = threading.get_ident()
+            if writer != owner and _get_running_loop() is not None:
+                _record(self, name, owner, writer)
+        cls.__setattr__(self, name, value)
+
+    sub = type(cls.__name__, (cls,), {
+        "__setattr__": __setattr__,
+        "_affinity_instrumented": True,
+        "__module__": cls.__module__,
+    })
+    _instrumented[cls] = sub
+    return sub
+
+
+def stamp(obj: object) -> object:
+    """Mark ``obj`` as owned by the calling thread's loop. No-op (and
+    free) while the detector is disabled."""
+    if not _enabled:
+        return obj
+    cls = type(obj)
+    if not getattr(cls, "_affinity_instrumented", False):
+        try:
+            obj.__class__ = _instrument(cls)
+        except TypeError:  # __slots__/extension layouts: skip quietly
+            return obj
+    object.__setattr__(obj, _OWNER, threading.get_ident())
+    return obj
+
+
+def rebind(obj: object) -> object:
+    """Transfer ownership to the calling thread — the sanctioned seam
+    for handing an object to another loop (stamp again, by intent)."""
+    return stamp(obj)
+
+
+def owner_ident(obj: object) -> Optional[int]:
+    return getattr(obj, _OWNER, None)
+
+
+if os.environ.get("TPUMINTER_LOOP_AFFINITY") == "1":  # pragma: no cover
+    enable(strict=os.environ.get("TPUMINTER_LOOP_AFFINITY_STRICT") == "1")

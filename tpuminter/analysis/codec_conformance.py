@@ -1,0 +1,316 @@
+"""codec-conformance: statically re-prove the PR 4 wire/journal codec
+invariants from the struct tables themselves.
+
+The binary codec's safety story rests on table-level invariants that
+golden tests only sample: every tag names exactly one layout, no tag
+collides with ``0x7B`` (``{`` — the JSON sniff byte, PR 4's
+dual-stack dispatch), every fixed-length kind in a module has a
+*distinct total length* (length is the secondary dispatch key on the
+decode path), every binary kind carries a CRC trailer, and every
+``Q``/``32s`` field is range-guarded before pack. This checker parses
+``_TAG_*`` / ``*_TAG`` constants and ``struct.Struct("...")`` layouts
+out of the AST, pairs them by name stem, and proves the invariants
+over the whole extracted table — so adding a new record kind that
+reuses a length or skips the CRC fails lint, not a 2 a.m. decode.
+
+The table core (:func:`check_table`) is pure data-in/violations-out —
+``tests/test_properties.py`` drives it with randomized tables to pin
+the invariant logic itself.
+"""
+
+from __future__ import annotations
+
+import ast
+import struct as struct_mod
+from typing import Dict, List, Optional, Sequence
+
+from tpuminter.analysis.core import Finding, ModuleSource, dotted
+
+CHECKER = "codec-conformance"
+
+JSON_SNIFF_BYTE = 0x7B  # "{" — first byte of every JSON frame
+
+
+# ---------------------------------------------------------------------------
+# pure table checks (hypothesis-tested)
+# ---------------------------------------------------------------------------
+
+def struct_size(fmt: str) -> Optional[int]:
+    try:
+        return struct_mod.calcsize(fmt)
+    except struct_mod.error:
+        return None
+
+
+def check_table(kinds: Sequence[dict]) -> List[dict]:
+    """Prove the codec invariants over a kind table.
+
+    Each kind is a dict with keys ``name``, ``module``, ``line``,
+    ``tag`` (int or None), ``fmt`` (struct format or None),
+    ``has_crc`` (bool), ``variable`` (bool — header of a
+    variable-length record, excluded from the distinct-length rule).
+    Returns violation dicts: ``{"violation", "kind", "module", "line",
+    "message"}``.
+    """
+    out: List[dict] = []
+
+    def flag(kind: dict, violation: str, message: str) -> None:
+        out.append({
+            "violation": violation,
+            "kind": kind["name"],
+            "module": kind["module"],
+            "line": kind.get("line", 0),
+            "message": message,
+        })
+
+    # one layout per tag (the whole process shares one byte namespace:
+    # WAL frames carry journal records next to wire records)
+    by_tag: Dict[int, List[dict]] = {}
+    for kind in kinds:
+        if kind.get("tag") is not None:
+            by_tag.setdefault(kind["tag"], []).append(kind)
+    for tag, group in sorted(by_tag.items()):
+        if len(group) > 1:
+            names = ", ".join(sorted(k["name"] for k in group))
+            for kind in group[1:]:
+                flag(kind, "duplicate-tag",
+                     f"tag 0x{tag:02X} is claimed by multiple kinds "
+                     f"({names}) — the decoder cannot tell them apart")
+        if tag == JSON_SNIFF_BYTE:
+            for kind in group:
+                flag(kind, "json-collision",
+                     f"tag 0x{tag:02X} is '{{' — it would be sniffed as "
+                     f"a JSON frame by the dual-stack dispatch")
+
+    # distinct total length per module among fixed-length kinds
+    by_module: Dict[str, List[dict]] = {}
+    for kind in kinds:
+        if kind.get("fmt") and not kind.get("variable"):
+            by_module.setdefault(kind["module"], []).append(kind)
+    for module, group in sorted(by_module.items()):
+        by_size: Dict[int, List[dict]] = {}
+        for kind in group:
+            size = struct_size(kind["fmt"])
+            if size is not None:
+                by_size.setdefault(size, []).append(kind)
+        for size, clash in sorted(by_size.items()):
+            if len(clash) > 1:
+                names = ", ".join(sorted(k["name"] for k in clash))
+                for kind in sorted(
+                    clash, key=lambda k: k.get("line", 0)
+                )[1:]:
+                    flag(kind, "length-collision",
+                         f"total packed length {size} is shared by "
+                         f"{names} — length is the secondary dispatch "
+                         f"key; every fixed-length kind needs a "
+                         f"distinct one")
+
+    for kind in kinds:
+        fmt = kind.get("fmt")
+        if fmt:
+            body = fmt[1:] if fmt[:1] in "<>=!@" else fmt
+            if kind.get("tag") is not None and not body.startswith("B"):
+                flag(kind, "tag-not-first",
+                     f"layout {fmt!r} does not begin with the u8 tag "
+                     f"byte — the sniff/dispatch path reads byte 0")
+        if not kind.get("has_crc"):
+            flag(kind, "missing-crc",
+                 "binary kind is packed without a CRC trailer "
+                 "(_seal(...) on the wire, frame_payload(...) in the "
+                 "journal) — torn/corrupt records would decode "
+                 "silently")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# AST front-end: extract the kind table from a module
+# ---------------------------------------------------------------------------
+
+def _stem(name: str) -> Optional[str]:
+    """Normalize a constant name to its record-kind stem, or None when
+    the name is not codec-shaped."""
+    s = name.lstrip("_")
+    matched = False
+    if s.startswith("TAG_"):
+        s, matched = s[4:], True
+    if s.startswith("BIN_"):
+        s, matched = s[4:], True
+    if s.endswith("_TAG"):
+        s, matched = s[:-4], True
+    if s.endswith("_HEAD"):
+        s = s[:-5]
+    return s if (matched or name.startswith("_")) and s else None
+
+
+def _module_has_crc_framer(tree: ast.Module) -> bool:
+    """A module-level function that feeds payloads through
+    ``zlib.crc32`` frames every record it writes (journal.py's
+    ``frame_payload``)."""
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    name = dotted(sub.func)
+                    if name in ("zlib.crc32", "crc32"):
+                        return True
+    return False
+
+
+def _sealed_names(tree: ast.Module) -> set:
+    """Names mentioned inside the argument subtree of any ``_seal``-ish
+    call — the wire codec's per-record CRC trailer."""
+    sealed = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name is not None and "seal" in name.rsplit(".", 1)[-1].lower():
+                for arg in node.args:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Name):
+                            sealed.add(sub.id)
+    return sealed
+
+
+def extract_kinds(src: ModuleSource) -> List[dict]:
+    tags: Dict[str, dict] = {}     # stem -> {name, line, tag}
+    layouts: Dict[str, dict] = {}  # stem -> {name, line, fmt}
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        stem = _stem(target.id)
+        if stem is None:
+            continue
+        if isinstance(node.value, ast.Constant) and isinstance(
+            node.value.value, int
+        ) and not isinstance(node.value.value, bool):
+            if ("TAG" in target.id.upper()):
+                tags[stem] = {
+                    "name": target.id, "line": node.lineno,
+                    "tag": node.value.value,
+                }
+        elif isinstance(node.value, ast.Call):
+            ctor = dotted(node.value.func)
+            if ctor in ("struct.Struct", "Struct") and node.value.args:
+                fmt_node = node.value.args[0]
+                if isinstance(fmt_node, ast.Constant) and isinstance(
+                    fmt_node.value, str
+                ):
+                    layouts[stem] = {
+                        "name": target.id, "line": node.lineno,
+                        "fmt": fmt_node.value,
+                        "variable": target.id.endswith("_HEAD"),
+                    }
+
+    module_crc = _module_has_crc_framer(src.tree)
+    sealed = _sealed_names(src.tree)
+
+    kinds: List[dict] = []
+    for stem in sorted(set(tags) | set(layouts)):
+        tag = tags.get(stem)
+        layout = layouts.get(stem)
+        if layout is None:
+            continue  # a tag constant without a layout is not a codec kind
+        kinds.append({
+            "name": layout["name"],
+            "module": src.path,
+            "line": layout["line"],
+            "tag": tag["tag"] if tag else None,
+            "fmt": layout["fmt"],
+            "variable": layout["variable"],
+            "has_crc": module_crc or layout["name"] in sealed,
+        })
+    return kinds
+
+
+def _u64_guard_findings(src: ModuleSource) -> List[Finding]:
+    """Functions that ``.pack`` a Q-bearing layout must range-check
+    against ``_U64`` / ``_U256`` first."""
+    q_layouts = {
+        k["name"] for k in extract_kinds(src)
+        if "Q" in (k["fmt"] or "")
+    }
+    if not q_layouts:
+        return []
+    findings = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        packs = []
+        guarded = False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                name = dotted(sub.func)
+                if (
+                    name is not None
+                    and "." in name
+                    and name.rsplit(".", 1)[-1] == "pack"
+                ):
+                    owner = name.split(".")[-2]
+                    if owner in q_layouts:
+                        packs.append((sub.lineno, owner))
+            if isinstance(sub, ast.Compare):
+                for part in ast.walk(sub):
+                    ref = dotted(part)
+                    if ref is not None and ref.rsplit(".", 1)[-1] in (
+                        "_U64", "_U256"
+                    ):
+                        guarded = True
+        if packs and not guarded:
+            for line, layout in packs:
+                findings.append(Finding(
+                    CHECKER, src.path, line, node.name, layout,
+                    f"{layout}.pack() on a u64-bearing layout without a "
+                    f"_U64/_U256 range guard in the same function — "
+                    f"struct.pack raises (or silently truncates via "
+                    f"masking upstream) on out-of-range values; guard "
+                    f"like protocol._encode_binary or justify the "
+                    f"caller-side contract in the allowlist",
+                ))
+    return findings
+
+
+def check_module(src: ModuleSource) -> List[Finding]:
+    kinds = extract_kinds(src)
+    if not kinds:
+        return []
+    findings = []
+    for v in check_table(kinds):
+        findings.append(Finding(
+            CHECKER, src.path, v["line"],
+            "", f"{v['violation']}:{v['kind']}", v["message"],
+        ))
+    findings.extend(_u64_guard_findings(src))
+    return findings
+
+
+def check_project(modules: Sequence[ModuleSource]) -> List[Finding]:
+    """Cross-module tag namespace: journal records ride inside WAL
+    frames next to wire records — one byte namespace for the process."""
+    all_kinds = []
+    for src in modules:
+        all_kinds.extend(extract_kinds(src))
+    by_tag: Dict[int, List[dict]] = {}
+    for kind in all_kinds:
+        if kind.get("tag") is not None:
+            by_tag.setdefault(kind["tag"], []).append(kind)
+    findings = []
+    for tag, group in sorted(by_tag.items()):
+        mods = {k["module"] for k in group}
+        if len(mods) > 1:
+            names = ", ".join(
+                f"{k['module']}:{k['name']}" for k in sorted(
+                    group, key=lambda k: (k["module"], k["name"])
+                )
+            )
+            for kind in sorted(group, key=lambda k: k["module"])[1:]:
+                findings.append(Finding(
+                    CHECKER, kind["module"], kind["line"], "",
+                    f"cross-module-tag:{kind['name']}",
+                    f"tag 0x{tag:02X} is claimed in multiple modules "
+                    f"({names}) — WAL shipping puts journal and wire "
+                    f"records in one byte namespace",
+                ))
+    return findings

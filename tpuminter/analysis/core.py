@@ -1,0 +1,264 @@
+"""Shared machinery for the project checkers: findings, the justified
+allowlist, and the tree runner.
+
+A :class:`Finding` is keyed by ``(checker, path, qualname, symbol)`` —
+NOT by line number — so allowlist entries survive unrelated edits to the
+file above them. Every allowlist entry must carry a non-empty
+``reason`` and must still match a real finding: a stale entry (the code
+it justified was fixed or removed) is itself reported as a finding, so
+the list can only shrink back to truth, never rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Allowlist",
+    "Finding",
+    "ModuleSource",
+    "Report",
+    "default_allowlist_path",
+    "iter_python_files",
+    "parse_module",
+    "qualname_index",
+    "run_project",
+]
+
+#: Checker registry: name → module path (imported lazily so importing
+#: :mod:`tpuminter.analysis` for the runtime affinity hooks never pays
+#: for checker machinery).
+CHECKERS = (
+    "loop-blocker",
+    "retrace-hazard",
+    "thread-seam",
+    "codec-conformance",
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One checker hit, stable across unrelated edits (see module doc)."""
+
+    checker: str
+    path: str       # repo-relative, posix separators
+    line: int
+    qualname: str   # enclosing def/class dotted path ("" at module level)
+    symbol: str     # the offending callable / attribute / codec kind
+    message: str
+
+    def key(self) -> Tuple[str, str, str, str]:
+        return (self.checker, self.path, self.qualname, self.symbol)
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}"
+        ctx = f" in {self.qualname}" if self.qualname else ""
+        return f"{where}: [{self.checker}] {self.symbol}{ctx}: {self.message}"
+
+    def as_dict(self) -> dict:
+        return {
+            "checker": self.checker,
+            "path": self.path,
+            "line": self.line,
+            "qualname": self.qualname,
+            "symbol": self.symbol,
+            "message": self.message,
+        }
+
+
+@dataclass
+class ModuleSource:
+    """A parsed target file handed to every checker."""
+
+    path: str           # repo-relative
+    tree: ast.Module
+    source: str
+
+
+@dataclass
+class Report:
+    """The outcome of one tree run: what fired, what the allowlist
+    absorbed, and which allowlist entries no longer earn their keep."""
+
+    findings: List[Finding] = field(default_factory=list)
+    suppressed: List[Finding] = field(default_factory=list)
+    stale_entries: List[dict] = field(default_factory=list)
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.stale_entries
+
+    def render(self) -> List[str]:
+        out = [f.render() for f in self.findings]
+        for entry in self.stale_entries:
+            out.append(
+                "allowlist: [stale-entry] {checker}:{path}:{qualname}:"
+                "{symbol}: no finding matches this entry any more — "
+                "delete it (reason was: {reason})".format(**entry)
+            )
+        return out
+
+
+def default_allowlist_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "allowlist.json")
+
+
+class Allowlist:
+    """The committed set of justified findings (``allowlist.json``).
+
+    Policy: an entry suppresses exactly one finding key and MUST say
+    why that finding is deliberate — one line, present tense, naming
+    the guard that makes the flagged pattern safe (``tier-1 gates it``
+    is not a reason; ``inline fsync stays under INLINE_FSYNC_BUDGET_S
+    with a sticky executor fallback`` is).
+    """
+
+    def __init__(self, entries: Sequence[dict]):
+        for e in entries:
+            missing = {"checker", "path", "qualname", "symbol", "reason"} - set(e)
+            if missing:
+                raise ValueError(f"allowlist entry {e!r} missing {missing}")
+            if not str(e["reason"]).strip():
+                raise ValueError(
+                    f"allowlist entry for {e['checker']}:{e['path']}:"
+                    f"{e['symbol']} has an empty reason"
+                )
+        self.entries = list(entries)
+        self._by_key = {
+            (e["checker"], e["path"], e["qualname"], e["symbol"]): e
+            for e in entries
+        }
+        if len(self._by_key) != len(entries):
+            raise ValueError("duplicate allowlist entries")
+
+    @classmethod
+    def load(cls, path: Optional[str] = None) -> "Allowlist":
+        path = path or default_allowlist_path()
+        if not os.path.exists(path):
+            return cls([])
+        with open(path, "r", encoding="utf-8") as fh:
+            return cls(json.load(fh))
+
+    def apply(self, findings: Iterable[Finding]) -> Report:
+        report = Report()
+        used = set()
+        for f in findings:
+            if f.key() in self._by_key:
+                used.add(f.key())
+                report.suppressed.append(f)
+            else:
+                report.findings.append(f)
+        report.stale_entries = [
+            e for k, e in self._by_key.items() if k not in used
+        ]
+        return report
+
+
+# ---------------------------------------------------------------------------
+# tree walking
+# ---------------------------------------------------------------------------
+
+def iter_python_files(root: str, targets: Sequence[str]) -> List[str]:
+    """Repo-relative paths of every ``.py`` under the target dirs (or
+    the targets themselves when they are files), sorted for stable
+    output."""
+    out = []
+    for target in targets:
+        full = os.path.join(root, target)
+        if os.path.isfile(full) and full.endswith(".py"):
+            out.append(os.path.relpath(full, root))
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for name in filenames:
+                if name.endswith(".py"):
+                    out.append(
+                        os.path.relpath(os.path.join(dirpath, name), root)
+                    )
+    return sorted(p.replace(os.sep, "/") for p in out)
+
+
+def parse_module(root: str, relpath: str) -> ModuleSource:
+    with open(os.path.join(root, relpath), "r", encoding="utf-8") as fh:
+        source = fh.read()
+    return ModuleSource(
+        path=relpath, tree=ast.parse(source, filename=relpath), source=source
+    )
+
+
+def qualname_index(tree: ast.Module) -> Dict[ast.AST, str]:
+    """Map every def/class node (and every node inside one) to the
+    dotted qualname of its innermost enclosing def/class."""
+    index: Dict[ast.AST, str] = {}
+
+    def visit(node: ast.AST, qual: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_qual = qual
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+            ):
+                child_qual = f"{qual}.{child.name}" if qual else child.name
+            index[child] = child_qual
+            visit(child, child_qual)
+
+    index[tree] = ""
+    visit(tree, "")
+    return index
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None (calls,
+    subscripts and anything dynamic break the chain on purpose — the
+    checkers only ever match statically-resolvable references)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def run_project(
+    root: str,
+    targets: Sequence[str] = ("tpuminter", "scripts"),
+    *,
+    allowlist: Optional[Allowlist] = None,
+    checkers: Optional[Sequence[str]] = None,
+) -> Report:
+    """Run every checker over the target dirs and fold the allowlist in.
+
+    Checkers see each module individually (``check_module``) and, when
+    they define it, the whole parsed set at once (``check_project`` —
+    the codec checker's cross-module tag-namespace invariant)."""
+    from tpuminter.analysis import (
+        codec_conformance,
+        loop_blocker,
+        retrace,
+        thread_seam,
+    )
+
+    registry = {
+        "loop-blocker": loop_blocker,
+        "retrace-hazard": retrace,
+        "thread-seam": thread_seam,
+        "codec-conformance": codec_conformance,
+    }
+    selected = checkers or CHECKERS
+    modules = [parse_module(root, p) for p in iter_python_files(root, targets)]
+    findings: List[Finding] = []
+    for name in selected:
+        mod = registry[name]
+        for src in modules:
+            findings.extend(mod.check_module(src))
+        if hasattr(mod, "check_project"):
+            findings.extend(mod.check_project(modules))
+    findings.sort(key=lambda f: (f.path, f.line, f.checker, f.symbol))
+    allowlist = allowlist if allowlist is not None else Allowlist.load()
+    return allowlist.apply(findings)

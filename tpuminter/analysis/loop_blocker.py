@@ -1,0 +1,314 @@
+"""loop-blocker: blocking work reachable from the event loop (PR 2/3's
+bug class).
+
+The coordinator's control plane is one asyncio loop per shard; a single
+blocking call on it stalls every heartbeat, epoch timer, and dispatch
+behind it (PR 2 measured the on-loop scrypt verify at ~301 µs *per
+result*; PR 3's fsync war moved disk flushes behind an adaptive
+executor seam). This checker walks each module's AST, marks the
+functions that execute on a loop — ``async def`` bodies, callbacks
+scheduled via ``call_soon`` / ``call_soon_threadsafe`` / ``call_later``
+/ ``add_done_callback``, and every same-module sync function such a
+function calls — and flags calls (and bare references, which are one
+indirection away from a call) to a curated set of blocking operations,
+unless the reference is being handed to an executor seam
+(``run_in_executor`` / ``asyncio.to_thread``).
+
+Intra-module only, by design: name-based call resolution (``self.x`` to
+the enclosing class, bare names to siblings then module scope) is
+exact enough to be quiet, and the cross-module entry points that block
+on purpose (``Journal.open`` at startup) are named directly in the
+curated set so call sites surface where the decision is made.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from tpuminter.analysis.core import Finding, ModuleSource, dotted
+
+CHECKER = "loop-blocker"
+
+#: Fully-dotted blocking calls (exact match on the resolved reference).
+BLOCKING_EXACT = {
+    "os.fsync",
+    "os.fdatasync",
+    "time.sleep",
+    "hashlib.scrypt",
+    "hashlib.pbkdf2_hmac",
+    "socket.create_connection",
+    "subprocess.run",
+    "subprocess.check_output",
+    "subprocess.check_call",
+    "shutil.rmtree",
+    "shutil.copyfile",
+    "open",
+}
+
+#: Project functions known to do file I/O or memory-hard hashing,
+#: matched on their final name segment (they are imported bare as often
+#: as dotted). Kept short and unambiguous on purpose.
+BLOCKING_PROJECT = {
+    "scrypt_hash",      # chain.scrypt_hash — hashlib.scrypt, ~301 µs
+    "read_span",        # journal file slice read
+    "scan_file",        # whole-WAL scan
+    "cursor_valid",     # re-reads the tail record from disk
+    "toy_hash",         # host dsha256 — cheap, but a per-call budget
+}
+# NOT in the set: scan_with_cursor — it parses an in-memory bytes
+# batch (no I/O); the standby calls it per WAL batch on purpose.
+#: ...except these, which are cheap enough to run inline by the
+#: numbers (kept out of the default set; listed for documentation).
+BLOCKING_PROJECT -= {"toy_hash"}
+
+#: Dotted suffixes for the journal's blocking constructors.
+BLOCKING_SUFFIXES = (
+    "Journal.open",
+    "Journal.fresh",
+    "Journal.adopt",
+)
+
+#: A reference passed into one of these is the sanctioned offload.
+EXECUTOR_SEAMS = ("run_in_executor", "to_thread")
+
+#: Scheduling calls whose callback argument runs ON the loop.
+LOOP_SCHEDULERS = (
+    "call_soon",
+    "call_soon_threadsafe",
+    "call_later",
+    "call_at",
+    "add_done_callback",
+)
+
+
+def _is_blocking(name: Optional[str]) -> Optional[str]:
+    """The canonical blocked-operation symbol for a resolved reference,
+    or None."""
+    if name is None:
+        return None
+    if name in BLOCKING_EXACT:
+        return name
+    base = name.rsplit(".", 1)[-1]
+    if base in BLOCKING_PROJECT:
+        return name
+    for suffix in BLOCKING_SUFFIXES:
+        if name == suffix or name.endswith("." + suffix):
+            return suffix
+    return None
+
+
+@dataclass
+class _Func:
+    node: ast.AST
+    qual: str
+    is_async: bool
+    cls: Optional[str]       # enclosing class name, if a method
+    parent: Optional[str]    # enclosing function qual, if nested
+    calls: List[Tuple[str, int]] = field(default_factory=list)
+    #: loop-context provenance, None until marked
+    why: Optional[str] = None
+
+
+class _Collector(ast.NodeVisitor):
+    """First pass: every function, its enclosing class/function, and
+    scheduler/executor call sites."""
+
+    def __init__(self) -> None:
+        self.funcs: Dict[str, _Func] = {}
+        self._class_stack: List[str] = []
+        self._func_stack: List[str] = []
+        self.scheduled_refs: List[str] = []   # names handed to LOOP_SCHEDULERS
+        self.thread_targets: List[str] = []   # names handed to threading.Thread
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _visit_func(self, node) -> None:
+        cls = self._class_stack[-1] if self._class_stack else None
+        parent = self._func_stack[-1] if self._func_stack else None
+        if parent:
+            qual = f"{parent}.{node.name}"
+        elif cls:
+            qual = f"{cls}.{node.name}"
+        else:
+            qual = node.name
+        self.funcs[qual] = _Func(
+            node, qual, isinstance(node, ast.AsyncFunctionDef), cls, parent
+        )
+        self._func_stack.append(qual)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted(node.func)
+        if name is not None:
+            base = name.rsplit(".", 1)[-1]
+            if base in LOOP_SCHEDULERS:
+                for arg in node.args[:2]:
+                    ref = dotted(arg)
+                    if ref is not None:
+                        self.scheduled_refs.append(ref)
+            if name.endswith("Thread") or name == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        ref = dotted(kw.value)
+                        if ref is not None:
+                            self.thread_targets.append(ref)
+        self.generic_visit(node)
+
+
+def _resolve(
+    funcs: Dict[str, _Func], caller: _Func, ref: str
+) -> Optional[str]:
+    """Resolve a reference from inside ``caller`` to a function qual."""
+    if ref.startswith("self.") or ref.startswith("cls."):
+        if caller.cls is not None:
+            cand = f"{caller.cls}.{ref.split('.', 1)[1]}"
+            if cand in funcs:
+                return cand
+        return None
+    if "." in ref:
+        return ref if ref in funcs else None
+    # bare name: nested sibling first, then module scope
+    scope = caller.qual
+    while scope:
+        cand = f"{scope}.{ref}"
+        if cand in funcs:
+            return cand
+        scope = scope.rsplit(".", 1)[0] if "." in scope else ""
+    return ref if ref in funcs else None
+
+
+def _direct_statements(func: _Func):
+    """Nodes belonging to this function, excluding nested defs (those
+    are analyzed as their own functions)."""
+    stack = list(ast.iter_child_nodes(func.node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def check_module(src: ModuleSource) -> List[Finding]:
+    collector = _Collector()
+    collector.visit(src.tree)
+    funcs = collector.funcs
+
+    # -- call graph (direct statements only) -----------------------------
+    for func in funcs.values():
+        for node in _direct_statements(func):
+            if isinstance(node, ast.Call):
+                ref = dotted(node.func)
+                if ref is None:
+                    continue
+                target = _resolve(funcs, func, ref)
+                if target is not None:
+                    func.calls.append((target, node.lineno))
+
+    # -- loop-context marking + propagation ------------------------------
+    pending: List[str] = []
+    for func in funcs.values():
+        if func.is_async:
+            func.why = "async def"
+            pending.append(func.qual)
+    for ref in collector.scheduled_refs:
+        # scheduler callbacks: resolve from module scope or any class
+        for qual, func in funcs.items():
+            base = ref.split(".", 1)[1] if ref.startswith("self.") else ref
+            if qual == base or qual.endswith("." + base.rsplit(".", 1)[-1]):
+                if qual.rsplit(".", 1)[-1] == base.rsplit(".", 1)[-1]:
+                    if func.why is None:
+                        func.why = "scheduled onto the loop"
+                        pending.append(qual)
+    while pending:
+        qual = pending.pop()
+        func = funcs[qual]
+        for callee, _line in func.calls:
+            target = funcs[callee]
+            if target.why is None and not target.is_async:
+                target.why = f"called from {qual} ({func.why})"
+                pending.append(callee)
+
+    # -- blocking sites inside loop-context functions --------------------
+    findings: List[Finding] = []
+    for func in funcs.values():
+        if func.why is None:
+            continue
+        exempt_refs: Set[int] = set()  # node ids referenced via executor seams
+        for node in _direct_statements(func):
+            if isinstance(node, ast.Call):
+                # the func name may not be statically resolvable when
+                # chained through a call (asyncio.get_running_loop()
+                # .run_in_executor(...)) — match the final attribute
+                name = dotted(node.func)
+                leaf = (
+                    name.rsplit(".", 1)[-1] if name is not None
+                    else node.func.attr
+                    if isinstance(node.func, ast.Attribute) else None
+                )
+                if leaf in EXECUTOR_SEAMS + ("partial",):
+                    for arg in ast.walk(node):
+                        if arg is not node:
+                            exempt_refs.add(id(arg))
+        for node in _direct_statements(func):
+            if isinstance(node, ast.Call):
+                symbol = _is_blocking(dotted(node.func))
+                if symbol is not None and id(node) not in exempt_refs:
+                    findings.append(Finding(
+                        CHECKER, src.path, node.lineno, func.qual, symbol,
+                        f"blocking call on the event loop ({func.why}); "
+                        f"route it through loop.run_in_executor or move it "
+                        f"off the loop path",
+                    ))
+            elif isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+                getattr(node, "ctx", None), ast.Load
+            ):
+                symbol = _is_blocking(dotted(node))
+                if (
+                    symbol is not None
+                    and id(node) not in exempt_refs
+                    and not _is_call_func(src.tree, node)
+                ):
+                    findings.append(Finding(
+                        CHECKER, src.path, node.lineno, func.qual, symbol,
+                        f"blocking callable referenced on the event loop "
+                        f"({func.why}); if invoked here it blocks the loop "
+                        f"— hand it to an executor seam instead",
+                    ))
+    return _dedupe(findings)
+
+
+_CALL_FUNCS_CACHE: Dict[int, Set[int]] = {}
+
+
+def _is_call_func(tree: ast.Module, node: ast.AST) -> bool:
+    """Whether ``node`` is the function position of a Call (then the
+    Call branch already judged it)."""
+    key = id(tree)
+    if key not in _CALL_FUNCS_CACHE:
+        _CALL_FUNCS_CACHE.clear()  # one tree at a time is plenty
+        _CALL_FUNCS_CACHE[key] = {
+            id(c.func) for c in ast.walk(tree) if isinstance(c, ast.Call)
+        }
+    return id(node) in _CALL_FUNCS_CACHE[key]
+
+
+def _dedupe(findings: List[Finding]) -> List[Finding]:
+    seen: Set[Tuple] = set()
+    out = []
+    for f in findings:
+        k = (f.key(), f.line)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out

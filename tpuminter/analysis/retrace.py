@@ -1,0 +1,150 @@
+"""retrace-hazard: ``jax.jit`` / ``pallas_call`` wrappers built per
+call (PR 7's bug class).
+
+A jitted callable caches its traces on the *wrapper object*. Construct
+the wrapper inside a function and every invocation starts from an empty
+cache: PR 7 measured ~0.6 s of re-trace per job on the rolled-sweep
+path before the kernel factories moved behind ``lru_cache``. This
+checker flags any ``jax.jit`` / ``jax.pmap`` / ``pl.pallas_call``
+construction inside a function body unless one of the sanctioned
+memoization shapes encloses it:
+
+- the enclosing function (or an outer one) carries ``functools.lru_cache``
+  / ``functools.cache`` — the factory-with-cache idiom the tree uses;
+- the enclosing function is itself jitted at module level (``@jax.jit``
+  or ``@partial(jax.jit, ...)``) — inner wrappers then live inside the
+  outer trace and are built once per outer-cache entry.
+
+It also flags the sibling hazard: calls to a same-module ``lru_cache``d
+factory passing list/dict/set literals (or ``list()``/``dict()``/
+``set()`` calls) — unhashable arguments defeat the cache with a
+``TypeError`` at runtime, or (for ``jax.jit`` static args) force a
+retrace per call.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from tpuminter.analysis.core import Finding, ModuleSource, dotted
+
+CHECKER = "retrace-hazard"
+
+#: Constructors whose result caches traces on the wrapper object.
+TRACING_WRAPPERS = {
+    "jax.jit",
+    "jit",
+    "jax.pmap",
+    "pmap",
+    "pl.pallas_call",
+    "pallas_call",
+    "jax.experimental.pallas.pallas_call",
+}
+
+CACHE_DECORATORS = {"lru_cache", "cache"}
+
+
+def _decorator_names(node) -> List[str]:
+    """Flattened dotted names from a def's decorator list, looking
+    through ``partial(...)`` and ``lru_cache(...)`` call forms."""
+    names = []
+    for dec in node.decorator_list:
+        if isinstance(dec, ast.Call):
+            name = dotted(dec.func)
+            if name is not None:
+                names.append(name)
+                # @partial(jax.jit, ...) — the first arg is the real one
+                if name.rsplit(".", 1)[-1] == "partial" and dec.args:
+                    inner = dotted(dec.args[0])
+                    if inner is not None:
+                        names.append(inner)
+        else:
+            name = dotted(dec)
+            if name is not None:
+                names.append(name)
+    return names
+
+
+def _is_memoized(stack: List[ast.AST]) -> bool:
+    """Whether any enclosing def carries a cache decorator or is itself
+    a module-level jitted function."""
+    for node in stack:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for name in _decorator_names(node):
+                base = name.rsplit(".", 1)[-1]
+                if base in CACHE_DECORATORS:
+                    return True
+                if name in TRACING_WRAPPERS:
+                    return True
+    return False
+
+
+def _unhashable_arg(node: ast.Call) -> Optional[str]:
+    for arg in list(node.args) + [kw.value for kw in node.keywords]:
+        if isinstance(arg, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                            ast.DictComp, ast.SetComp)):
+            return type(arg).__name__.lower()
+        if isinstance(arg, ast.Call):
+            name = dotted(arg.func)
+            if name in ("list", "dict", "set"):
+                return f"{name}()"
+    return None
+
+
+def check_module(src: ModuleSource) -> List[Finding]:
+    findings: List[Finding] = []
+
+    # cached factories defined in this module (bare name), for the
+    # unhashable-argument check
+    cached_factories: Set[str] = set()
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for name in _decorator_names(node):
+                if name.rsplit(".", 1)[-1] in CACHE_DECORATORS:
+                    cached_factories.add(node.name)
+
+    def walk(node: ast.AST, stack: List[ast.AST], qual: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_qual = qual
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                child_qual = f"{qual}.{child.name}" if qual else child.name
+            if isinstance(child, ast.Call):
+                name = dotted(child.func)
+                in_function = any(
+                    isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    for s in stack + [node]
+                )
+                if (
+                    name in TRACING_WRAPPERS
+                    and in_function
+                    and not _is_memoized(stack + [node])
+                ):
+                    findings.append(Finding(
+                        CHECKER, src.path, child.lineno, qual, name,
+                        "tracing wrapper constructed inside a function "
+                        "without lru_cache-style memoization — every call "
+                        "re-traces from an empty cache (PR 7's ~0.6 s/job "
+                        "tax); hoist it to module level or put the factory "
+                        "behind functools.lru_cache",
+                    ))
+                if (
+                    name is not None
+                    and name.rsplit(".", 1)[-1] in cached_factories
+                ):
+                    bad = _unhashable_arg(child)
+                    if bad is not None:
+                        findings.append(Finding(
+                            CHECKER, src.path, child.lineno, qual, name,
+                            f"unhashable argument ({bad}) passed to the "
+                            f"lru_cache'd factory {name!r} — the cache "
+                            f"raises TypeError (or forces a retrace for "
+                            f"jit static args); pass a tuple / frozen "
+                            f"value instead",
+                        ))
+            walk(child, stack + [child], child_qual)
+
+    walk(src.tree, [src.tree], "")
+    return findings

@@ -1,0 +1,278 @@
+"""thread-seam: cross-loop attribute writes outside the sanctioned
+seams (PR 6's bug class).
+
+The multi-loop coordinator runs one event loop per shard thread plus
+the control loop that spawned them. Its memory model is narrow and
+deliberate: objects handed to a shard thread at spawn are *shard-homed*
+(only that shard's loop mutates them); everything crossing back goes
+through ``_Handoff`` / ``_JournalProxy`` (internally synchronized) or a
+``call_soon_threadsafe`` hop that re-homes the callable onto the
+owning loop. A bare ``shard.attr = ...`` from the control loop is the
+racy shortcut this checker exists to catch.
+
+The model, derived per module (only modules that create
+``threading.Thread`` are analyzed at all):
+
+- *shard classes*: classes whose instances are passed in ``args=`` of a
+  ``threading.Thread(...)`` construction;
+- *shard context*: the thread ``target=`` functions plus every
+  same-module function they call (fixed point) — writes there run on
+  the owning loop and are fine;
+- *seam callables*: functions referenced as arguments to
+  ``call_soon_threadsafe`` — they execute on the target loop, so their
+  writes are home writes;
+- *seam classes*: ``_Handoff`` and ``_JournalProxy`` method bodies are
+  the synchronization primitives themselves — skipped;
+- *creation phase*: a function that constructs the shard object
+  (``v = _Shard(...)``) owns it until the thread starts — its writes
+  are exempt.
+
+Everything else that stores to an attribute of a shard-homed variable
+(parameter annotated with a shard class, loop variable over a
+``*shards*`` collection, or a ``shards[...]`` subscript) is flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from tpuminter.analysis.core import Finding, ModuleSource, dotted
+
+CHECKER = "thread-seam"
+
+#: Internally-synchronized seam primitives: method bodies skipped.
+SEAM_CLASSES = {"_Handoff", "_JournalProxy"}
+
+
+@dataclass
+class _Func:
+    node: ast.AST
+    qual: str
+    cls: Optional[str]
+    calls: Set[str] = field(default_factory=set)
+
+
+class _Collector(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.funcs: Dict[str, _Func] = {}
+        self._class_stack: List[str] = []
+        self._func_stack: List[str] = []
+        self.thread_targets: Set[str] = set()
+        self.thread_arg_classes: Set[str] = set()
+        self.seam_scheduled: Set[str] = set()
+        #: variable name -> class name for `v = C(...)` at any scope,
+        #: used to map Thread args back to their classes
+        self._constructed: Dict[str, str] = {}
+
+    # -- structure -------------------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _visit_func(self, node) -> None:
+        cls = self._class_stack[-1] if self._class_stack else None
+        parent = self._func_stack[-1] if self._func_stack else None
+        if parent:
+            qual = f"{parent}.{node.name}"
+        elif cls:
+            qual = f"{cls}.{node.name}"
+        else:
+            qual = node.name
+        self.funcs[qual] = _Func(node, qual, cls)
+        self._func_stack.append(qual)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    # -- facts -----------------------------------------------------------
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if isinstance(node.value, ast.Call):
+            ctor = dotted(node.value.func)
+            if ctor is not None:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self._constructed[tgt.id] = ctor.rsplit(".", 1)[-1]
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted(node.func)
+        if name is not None:
+            base = name.rsplit(".", 1)[-1]
+            if base == "Thread" or name.endswith(".Thread"):
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        ref = dotted(kw.value)
+                        if ref is not None:
+                            self.thread_targets.add(ref)
+                    elif kw.arg == "args" and isinstance(
+                        kw.value, (ast.Tuple, ast.List)
+                    ):
+                        for elt in kw.value.elts:
+                            if isinstance(elt, ast.Name):
+                                cls = self._constructed.get(elt.id)
+                                if cls is not None:
+                                    self.thread_arg_classes.add(cls)
+            if base == "call_soon_threadsafe":
+                for arg in node.args[:1]:
+                    if isinstance(arg, ast.Call):  # partial(f, ...)
+                        inner = dotted(arg.func)
+                        if inner and inner.rsplit(".", 1)[-1] == "partial":
+                            arg = arg.args[0] if arg.args else arg
+                    ref = dotted(arg)
+                    if ref is not None:
+                        self.seam_scheduled.add(ref)
+        self.generic_visit(node)
+
+
+def _resolve(funcs: Dict[str, _Func], caller: _Func, ref: str) -> Optional[str]:
+    if ref.startswith("self.") or ref.startswith("cls."):
+        if caller.cls is not None:
+            cand = f"{caller.cls}.{ref.split('.', 1)[1]}"
+            if cand in funcs:
+                return cand
+        return None
+    if "." in ref:
+        return ref if ref in funcs else None
+    scope = caller.qual
+    while scope:
+        cand = f"{scope}.{ref}"
+        if cand in funcs:
+            return cand
+        scope = scope.rsplit(".", 1)[0] if "." in scope else ""
+    return ref if ref in funcs else None
+
+
+def _match_ref(funcs: Dict[str, _Func], ref: str) -> List[str]:
+    """All quals a self./bare reference could name (no caller context —
+    used for thread targets and seam-scheduled callables)."""
+    base = ref.split(".", 1)[1] if ref.startswith(("self.", "cls.")) else ref
+    leaf = base.rsplit(".", 1)[-1]
+    return [q for q in funcs if q == base or q.rsplit(".", 1)[-1] == leaf]
+
+
+def _direct_nodes(func: _Func):
+    stack = list(ast.iter_child_nodes(func.node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def check_module(src: ModuleSource) -> List[Finding]:
+    collector = _Collector()
+    collector.visit(src.tree)
+    if not collector.thread_targets:
+        return []  # module never spawns threads: no cross-loop surface
+    funcs = collector.funcs
+
+    shard_classes = set(collector.thread_arg_classes)
+
+    # call graph, then shard-context closure from the thread targets
+    for func in funcs.values():
+        for node in _direct_nodes(func):
+            if isinstance(node, ast.Call):
+                ref = dotted(node.func)
+                if ref is not None:
+                    target = _resolve(funcs, func, ref)
+                    if target is not None:
+                        func.calls.add(target)
+
+    shard_context: Set[str] = set()
+    pending: List[str] = []
+    for ref in collector.thread_targets:
+        pending.extend(_match_ref(funcs, ref))
+    while pending:
+        qual = pending.pop()
+        if qual in shard_context:
+            continue
+        shard_context.add(qual)
+        pending.extend(funcs[qual].calls)
+
+    seam_callables: Set[str] = set()
+    for ref in collector.seam_scheduled:
+        seam_callables.update(_match_ref(funcs, ref))
+
+    findings: List[Finding] = []
+    for func in funcs.values():
+        if func.qual in shard_context or func.qual in seam_callables:
+            continue
+        if func.cls in SEAM_CLASSES:
+            continue
+        # shard-homed variables visible in this function
+        homed: Set[str] = set()
+        constructed: Set[str] = set()
+        node = func.node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for arg in list(node.args.args) + list(node.args.kwonlyargs):
+                ann = getattr(arg, "annotation", None)
+                if ann is not None:
+                    ann_name = dotted(ann)
+                    if ann_name and ann_name.rsplit(".", 1)[-1] in shard_classes:
+                        homed.add(arg.arg)
+        for child in _direct_nodes(func):
+            if isinstance(child, ast.Assign) and isinstance(
+                child.value, ast.Call
+            ):
+                ctor = dotted(child.value.func)
+                if ctor and ctor.rsplit(".", 1)[-1] in shard_classes:
+                    for tgt in child.targets:
+                        if isinstance(tgt, ast.Name):
+                            homed.add(tgt.id)
+                            constructed.add(tgt.id)
+            if isinstance(child, (ast.For, ast.AsyncFor)) and isinstance(
+                child.target, ast.Name
+            ):
+                it_node = child.iter
+                # unwrap reversed(xs) / list(xs) / sorted(xs) etc.
+                while (
+                    isinstance(it_node, ast.Call)
+                    and isinstance(it_node.func, ast.Name)
+                    and it_node.args
+                ):
+                    it_node = it_node.args[0]
+                it = dotted(it_node)
+                if it is not None and "shard" in it.rsplit(".", 1)[-1].lower():
+                    homed.add(child.target.id)
+            if isinstance(child, ast.Assign) and isinstance(
+                child.value, ast.Subscript
+            ):
+                sub = dotted(child.value.value)
+                if sub is not None and "shard" in sub.rsplit(".", 1)[-1].lower():
+                    for tgt in child.targets:
+                        if isinstance(tgt, ast.Name):
+                            homed.add(tgt.id)
+        if not homed:
+            continue
+        for child in _direct_nodes(func):
+            targets = []
+            if isinstance(child, ast.Assign):
+                targets = child.targets
+            elif isinstance(child, (ast.AugAssign, ast.AnnAssign)):
+                targets = [child.target]
+            for tgt in targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id in homed
+                    and tgt.value.id not in constructed
+                ):
+                    var = tgt.value.id
+                    findings.append(Finding(
+                        CHECKER, src.path, child.lineno, func.qual,
+                        f"{var}.{tgt.attr}",
+                        f"attribute write on shard-homed object {var!r} "
+                        f"outside the ownership seams — this runs on a "
+                        f"thread that does not own the object; hop through "
+                        f"call_soon_threadsafe onto its loop (or justify "
+                        f"why the write is race-free, e.g. a GIL-atomic "
+                        f"handshake flag, in the allowlist)",
+                    ))
+    return findings

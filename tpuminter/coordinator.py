@@ -43,6 +43,7 @@ from dataclasses import replace as dc_replace
 from typing import Deque, Dict, List, Optional, Tuple
 
 from tpuminter import chain
+from tpuminter.analysis import affinity
 from tpuminter.journal import (
     WINNERS_CAP,
     Journal,
@@ -477,6 +478,10 @@ class Coordinator:
             #: of a failed-over epoch knocking on the promoted door)
             "replication_fenced": 0,
         }
+        # TPUMINTER_LOOP_AFFINITY=1: the coordinator is single-loop by
+        # contract (one per shard in multiloop); any mutation arriving
+        # from another loop's thread is a recorded race
+        affinity.stamp(self)
 
     @classmethod
     async def create(

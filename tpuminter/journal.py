@@ -72,6 +72,7 @@ import asyncio
 
 import logging
 
+from tpuminter.analysis import affinity
 from tpuminter.protocol import Request, request_from_obj, request_to_obj
 
 log = logging.getLogger("tpuminter.journal")
@@ -627,6 +628,11 @@ class Journal:
             "bytes": 0,
             "compactions": 0,
         }
+        # TPUMINTER_LOOP_AFFINITY=1: every mutation from a foreign
+        # loop's thread is a recorded race (executor threads exempt —
+        # _write_sync bumping self.size off-loop is the sanctioned
+        # seam). The multi-loop coordinator rebinds on handover.
+        affinity.stamp(self)
 
     # -- construction ----------------------------------------------------
 

@@ -48,8 +48,16 @@ EPOCH_CONNECT = 0xE7
 EPOCH_RESET = 0xE8
 
 
+#: Range guard for the epoch field: the incarnation id is u64 on the
+#: wire; an out-of-range value must fail loudly at the encode seam, not
+#: as a struct.error deep in the transport.
+_U64 = 1 << 64
+
+
 def encode_epoch(kind: int, epoch: int) -> bytes:
     """Build a seq-0 ACK epoch payload (connect-ack or reset)."""
+    if not 0 <= epoch < _U64:
+        raise ValueError(f"epoch out of u64 range: {epoch}")
     return _EPOCH.pack(kind, epoch)
 
 

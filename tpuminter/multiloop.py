@@ -100,6 +100,7 @@ import zlib
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
+from tpuminter.analysis import affinity
 from tpuminter.journal import (
     BATCH_WINDOW_S,
     Journal,
@@ -692,9 +693,15 @@ class MultiLoopCoordinator:
             writer_loop = shard.loop if k == 0 else self._shards[0].loop
             journal = _JournalProxy(self._journal_real, writer_loop)
             shard.journal = journal
+            if k == 0:
+                # ownership handover: the control loop opened/replayed
+                # the journal in create(); from here on shard 0's loop
+                # is its home (the affinity detector's sanctioned seam)
+                affinity.rebind(self._journal_real)
         elif self._seg_journals:
             journal = self._seg_journals[k]
             shard.journal = journal
+            affinity.rebind(journal)  # created in create(), homed here
         if k == 0 and replicate_to:
             from tpuminter.replication import ReplicationPrimary
 

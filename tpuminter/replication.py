@@ -79,6 +79,7 @@ import os
 import time
 from typing import Callable, List, Optional, Tuple
 
+from tpuminter.analysis import affinity
 from tpuminter.journal import (
     Journal,
     RecoveredState,
@@ -232,6 +233,9 @@ class ReplicationPrimary:
             self._wake.set()
 
         journal.on_batch = hook
+        # TPUMINTER_LOOP_AFFINITY=1: a shipping lane lives on the
+        # journal's writer loop; cross-loop pokes are recorded races
+        affinity.stamp(self)
 
     # -- lifecycle -------------------------------------------------------
 
@@ -528,6 +532,9 @@ class ReplicationStandby:
             "rejects": 0,
             "acks_sent": 0,
         }
+        # TPUMINTER_LOOP_AFFINITY=1: the standby is single-loop; see
+        # tpuminter.analysis.affinity
+        affinity.stamp(self)
 
     @classmethod
     async def create(
