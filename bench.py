@@ -753,6 +753,56 @@ def bench_replication(duration: float = 4.0, pairs: int = 3) -> dict:
     }
 
 
+def bench_chaos(duration: float = 1.2, seed: int = 0,
+                smoke: bool = False) -> dict:
+    """Chaos-matrix resilience figures (ISSUE 12), CPU-only like the
+    recovery/replication sections: one seeded sweep of the loadgen
+    chaos cells and the degradation envelope it measured.
+
+    - ``chaos_netsplit_blackout_ms`` / ``_detect_ms`` / ``_takeover_ms``
+      / ``_fence_ms`` — the netsplit cell: primary↔standby link cut
+      mid-burst, standby declares loss and promotes, the link heals,
+      the old primary fences itself (split brain contained), the fleet
+      lands on the promoted standby.
+    - ``chaos_byzantine_eviction_ms`` — forged Results flowing until
+      the offender's eviction lands.
+    - ``chaos_answers_lost`` / ``_duplicated`` / ``_poisoned`` — the
+      exactly-once ledger summed across EVERY cell; all must be 0
+      (``chaos_violations`` is the full ``chaos_check`` verdict count,
+      0 = the whole matrix held).
+    """
+    import asyncio
+
+    loadgen = _import_loadgen()
+
+    cells = loadgen.CHAOS_SMOKE_CELLS if smoke else loadgen.CHAOS_CELLS
+    matrix = asyncio.run(loadgen.run_chaos(
+        cells, seed=seed, duration=duration
+    ))
+    res = matrix["results"]
+    ns = res.get("netsplit", {})
+    bz = res.get("byzantine", {})
+    return {
+        "chaos_cells": list(matrix["cells"]),
+        "chaos_violations": len(loadgen.chaos_check(matrix)),
+        "chaos_netsplit_detect_ms": ns.get("detect_ms"),
+        "chaos_netsplit_blackout_ms": ns.get("netsplit_ms"),
+        "chaos_netsplit_takeover_ms": ns.get("takeover_ms"),
+        "chaos_netsplit_fence_ms": ns.get("fence_ms"),
+        "chaos_byzantine_eviction_ms": bz.get("eviction_ms"),
+        "chaos_miners_evicted": bz.get("miners_evicted"),
+        "chaos_answers_lost": sum(
+            m.get("answers_lost", 0) for m in res.values()
+        ),
+        "chaos_answers_duplicated": sum(
+            m.get("answers_duplicated", 0) for m in res.values()
+        ),
+        "chaos_poisoned_answers": sum(
+            m.get("poisoned_answers", 0) for m in res.values()
+        ),
+    }
+
+
 def bench_multiloop(fleet: int = 64, duration: float = 4.0,
                     pairs: int = 3) -> dict:
     """Multi-loop sharding + batched socket I/O cost accounting
@@ -1020,6 +1070,7 @@ def main() -> None:
         extra.update(bench_multiloop(fleet=8, duration=1.5, pairs=1))
         extra.update(bench_recovery(duration=1.5, pairs=1))
         extra.update(bench_replication(duration=1.5, pairs=1))
+        extra.update(bench_chaos(duration=1.0, smoke=True))
         extra.update(bench_rolled(pairs=1, nb_points=(8,)))
         extra.update(bench_native(seconds=0.5))
     elif jax.default_backend() == "cpu":
@@ -1036,6 +1087,7 @@ def main() -> None:
         extra.update(bench_multiloop())
         extra.update(bench_recovery())
         extra.update(bench_replication())
+        extra.update(bench_chaos())
         extra.update(bench_rolled())
         extra.update(bench_native())
     else:
@@ -1067,6 +1119,7 @@ def main() -> None:
         extra.update(bench_multiloop())
         extra.update(bench_recovery())
         extra.update(bench_replication())
+        extra.update(bench_chaos())
         extra.update(bench_rolled())
         extra.update(bench_native())
     ghs = rate / 1e9

@@ -686,7 +686,8 @@ def smoke_check(metrics: dict, params: Params = FAST) -> list:
 # ---------------------------------------------------------------------------
 
 async def _durable_client_loop(
-    ports, params: Params, cid: int, upper: int, ledger: dict
+    ports, params: Params, cid: int, upper: int, ledger: dict,
+    *, verify: bool = False,
 ) -> None:
     """Closed-loop client that survives coordinator restarts: one LSP
     connection reused across jobs; on loss it redials with jittered
@@ -695,7 +696,10 @@ async def _durable_client_loop(
     Every Result received is booked in ``ledger['answers']`` keyed by
     (cid, job_id) — the exactly-once evidence the crash metrics read.
     ``ports`` may be a list (failover address rotation, like the
-    resilient miners)."""
+    resilient miners). ``verify=True`` spot-checks every awaited answer
+    (``toy_hash(data, nonce) == hash_value``) and books mismatches in
+    ``ledger['poisoned']`` — the byzantine-containment evidence: a
+    forged Result that reached a client."""
     import random as _random
 
     from tpuminter.replication import dial_patience
@@ -746,6 +750,14 @@ async def _durable_client_loop(
                     key = (cid, msg.job_id)
                     answers[key] = answers.get(key, 0) + 1
                     if pending is not None and msg.job_id == pending.job_id:
+                        if verify and (
+                            not msg.found
+                            or chain.toy_hash(pending.data, msg.nonce)
+                            != msg.hash_value
+                        ):
+                            ledger["poisoned"] = (
+                                ledger.get("poisoned", 0) + 1
+                            )
                         pending = None
             except LspConnectionLost:
                 await client.close(drain_timeout=0.1)
@@ -1230,6 +1242,565 @@ def failover_check(metrics: dict, params: Params = FAST) -> list:
     return bad
 
 
+# ---------------------------------------------------------------------------
+# chaos scenario (ISSUE 12): the deterministic fault-plan matrix
+# ---------------------------------------------------------------------------
+
+#: the full matrix, one named cell per degradation class. Order matters
+#: only for reproducibility: cell seeds derive from (--seed, index).
+CHAOS_CELLS = (
+    "netsplit", "asym_loss", "delay_reorder",
+    "fsync_stall", "enospc", "byzantine",
+)
+#: the tier-1 smoke subset: one partition cell + one byzantine cell
+CHAOS_SMOKE_CELLS = ("netsplit", "byzantine")
+
+
+async def _byzantine_session(
+    port: int, params: Params, *, behavior: str, binary: bool = True,
+    connect_epochs: Optional[int] = None,
+) -> None:
+    """One hostile-worker session (the 15-440 untrusted-worker lineage
+    made concrete): Joins like an honest miner, then misbehaves per
+    ``behavior``:
+
+    - ``forge``  — answers every Assign with a Result whose hash_value
+      verifies against nothing (wrong-preimage claim); the coordinator
+      must reject it, requeue the chunk, and evict after
+      MAX_REJECTIONS.
+    - ``refuse`` — Refuses every Assign (a flood); the coordinator must
+      evict after MAX_REFUSALS instead of ping-ponging chunks forever.
+    - ``replay`` — answers honestly but re-sends its PREVIOUS Result
+      after each new one (stale/duplicate submissions, the post-
+      reconnect replay shape); the coordinator must ignore the stale
+      chunk ids without penalizing anyone.
+    """
+    w = await LspClient.connect(
+        "127.0.0.1", port, params, connect_epochs=connect_epochs
+    )
+    w.write(encode_msg(Join(
+        backend=f"byz-{behavior}", lanes=1,
+        codec="bin" if binary else "json",
+    )))
+    templates = {}
+    speak = {"binary": False}
+    last = {"msg": None}
+
+    def handle(raw) -> None:
+        if binary and not speak["binary"] and payload_is_binary(raw):
+            speak["binary"] = True
+        msg = decode_msg(raw)
+        if isinstance(msg, Setup):
+            templates[msg.request.job_id] = msg.request
+        elif isinstance(msg, Cancel):
+            templates.pop(msg.job_id, None)
+        elif isinstance(msg, Assign):
+            req = templates.get(msg.job_id)
+            if req is None or behavior == "refuse":
+                w.write(encode_msg(
+                    Refuse(msg.job_id, msg.chunk_id), binary=speak["binary"]
+                ))
+                return
+            if behavior == "forge":
+                # claim the range's first nonce but report a hash that
+                # matches no nonce at all: verification MUST fail
+                res = Result(
+                    msg.job_id, req.mode, nonce=msg.lower,
+                    hash_value=chain.toy_hash(req.data, msg.upper) ^ 1,
+                    found=True, searched=msg.upper - msg.lower + 1,
+                    chunk_id=msg.chunk_id,
+                )
+                w.write(encode_msg(res, binary=speak["binary"]))
+                return
+            res = Result(
+                msg.job_id, req.mode, nonce=msg.lower,
+                hash_value=chain.toy_hash(req.data, msg.lower),
+                found=True, searched=msg.upper - msg.lower + 1,
+                chunk_id=msg.chunk_id,
+            )
+            w.write(encode_msg(res, binary=speak["binary"]))
+            if last["msg"] is not None:
+                # stale replay: the previous chunk's Result again
+                w.write(encode_msg(last["msg"], binary=speak["binary"]))
+            last["msg"] = res
+
+    try:
+        while True:
+            raw = await w.read()
+            while raw is not None:
+                handle(raw)
+                raw = (
+                    w.read_nowait() if hasattr(w, "read_nowait") else None
+                )
+    except LspConnectionLost:
+        pass  # evicted (or coordinator gone): the redial wrapper returns
+    finally:
+        await w.close(drain_timeout=0.2)
+
+
+async def _byzantine_miner(
+    ports, params: Params, seed: int, *, behavior: str, binary: bool = True,
+) -> None:
+    """A byzantine actor that redials after eviction — repeat offenders
+    keep coming back, which is exactly what the containment has to
+    absorb (each re-Join restarts the offender's rejection budget)."""
+    import random as _random
+
+    if isinstance(ports, int):
+        ports = [ports]
+    from tpuminter.replication import dial_patience
+
+    rng = _random.Random(seed)
+    delays = jittered_backoff(0.05, 1.0, rng)
+    ce = dial_patience(ports)
+    attempt = 0
+    while True:
+        port = ports[attempt % len(ports)]
+        attempt += 1
+        try:
+            await _byzantine_session(
+                port, params, behavior=behavior, binary=binary,
+                connect_epochs=ce,
+            )
+            delays = jittered_backoff(0.05, 1.0, rng)
+        except LspConnectError:
+            pass
+        await asyncio.sleep(next(delays))
+
+
+async def _chaos_fleet_cell(
+    name: str,
+    seed: int,
+    *,
+    n_miners: int = 6,
+    n_clients: int = 2,
+    chunk_size: int = 1024,
+    chunks_per_job: Optional[int] = None,
+    params: Params = FAST,
+    pre: float = 0.8,
+    fault: float = 1.2,
+    post: float = 1.0,
+    drain: float = 10.0,
+    binary: bool = True,
+    pipeline_depth: int = 2,
+) -> dict:
+    """One single-coordinator matrix cell: journaled coordinator +
+    resilient fleet + verifying durable clients; the cell's fault is
+    installed mid-burst, held for ``fault`` seconds, healed, and the
+    exactly-once ledger is settled after a drain. Cells:
+
+    - ``asym_loss``     — 25% inbound-only loss (A→B dies, B→A flows)
+    - ``delay_reorder`` — delay + jitter + reorder + duplication, both
+      directions (the WAN-weather cell; must cause no false evictions)
+    - ``fsync_stall``   — every fsync sleeps 20 ms (slow disk; must trip
+      the slow-fsync executor fallback, not kill the journal)
+    - ``enospc``        — one write fails ENOSPC (full disk; must trip
+      the journal's loud availability-over-durability path)
+    - ``byzantine``     — forge/refuse/replay actors join the fleet
+      (verifier rejects → eviction → poisoned chunks re-mine)
+    """
+    import shutil
+
+    from tpuminter.chaos import DiskFaultPlan, FaultPlan
+
+    tmpdir = tempfile.mkdtemp(prefix="tpuminter-chaos-")
+    journal_path = os.path.join(tmpdir, "chaos.wal")
+    coord = await make_coordinator(
+        params=params, chunk_size=chunk_size, recover_from=journal_path,
+        binary_codec=binary, pipeline_depth=pipeline_depth,
+    )
+    port = coord.port
+    serve = asyncio.ensure_future(coord.serve())
+    if chunks_per_job is None:
+        chunks_per_job = max(8, 2 * n_miners)
+    upper = chunk_size * chunks_per_job - 1
+    ledger = {"answers": {}, "submitted": 0, "stop": False, "poisoned": 0}
+    byz_behaviors = []
+    honest = n_miners
+    if name == "byzantine":
+        byz_behaviors = ["forge", "forge", "refuse", "replay"]
+        honest = max(2, n_miners - len(byz_behaviors))
+    miners = [
+        asyncio.ensure_future(_resilient_instant_miner(
+            port, params, seed * 100 + i, binary=binary
+        ))
+        for i in range(honest)
+    ]
+    clients = [
+        asyncio.ensure_future(_durable_client_loop(
+            port, params, i, upper, ledger, verify=True
+        ))
+        for i in range(n_clients)
+    ]
+    byz: list = []
+    metrics: dict = {
+        "cell": name, "cell_seed": seed, "fleet": honest,
+        "byzantine": len(byz_behaviors), "clients": n_clients,
+    }
+    plan = None
+    try:
+        await asyncio.sleep(pre)
+        stats0 = dict(coord.stats)
+        t_fault = time.monotonic()
+        if name == "asym_loss":
+            plan = FaultPlan(seed).link(peer="*", direction="in", drop=0.25)
+            for ep in _endpoints(coord):
+                ep.set_fault_plan(plan)
+        elif name == "delay_reorder":
+            plan = FaultPlan(seed).link(
+                peer="*", direction="both", dup=0.1, reorder=0.25,
+                reorder_delay=0.02, delay=0.005, delay_jitter=0.01,
+            )
+            for ep in _endpoints(coord):
+                ep.set_fault_plan(plan)
+        elif name == "fsync_stall":
+            coord._journal.fault_plan = DiskFaultPlan(fsync_stall_s=0.02)
+        elif name == "enospc":
+            coord._journal.fault_plan = DiskFaultPlan(enospc_once=True)
+        elif name == "byzantine":
+            byz = [
+                asyncio.ensure_future(_byzantine_miner(
+                    port, params, seed * 100 + 50 + i, behavior=b,
+                    binary=binary,
+                ))
+                for i, b in enumerate(byz_behaviors)
+            ]
+        else:
+            raise ValueError(f"unknown chaos cell {name!r}")
+        if name == "byzantine":
+            # eviction latency: hostile actors join → first eviction
+            while (
+                coord.stats["miners_evicted"] == stats0["miners_evicted"]
+            ):
+                if time.monotonic() - t_fault > 10.0:
+                    break
+                await asyncio.sleep(0.005)
+            metrics["eviction_ms"] = round(
+                (time.monotonic() - t_fault) * 1e3, 1
+            )
+        await asyncio.sleep(fault)
+        # heal: every chaos fault is a WINDOW — the drain below settles
+        # the ledger on a healthy link, so anything still missing then
+        # was really lost, not merely late
+        for ep in _endpoints(coord):
+            ep.set_fault_plan(None)
+        if plan is not None:
+            metrics["plan_stats"] = dict(plan.stats)
+        if coord._journal is not None:
+            if coord._journal.fault_plan is not None:
+                metrics["disk_stats"] = dict(
+                    coord._journal.fault_plan.stats
+                )
+                coord._journal.fault_plan = None
+            metrics["fsync_slow_flipped"] = bool(
+                getattr(coord._journal, "_fsync_slow", False)
+            )
+            metrics["journal_failed"] = bool(
+                getattr(coord._journal, "_failed", False)
+            )
+        await asyncio.sleep(post)
+        for t in byz:
+            t.cancel()
+        await asyncio.gather(*byz, return_exceptions=True)
+        ledger["stop"] = True
+        done, pending_tasks = await asyncio.wait(clients, timeout=drain)
+        for t in pending_tasks:
+            t.cancel()
+        await asyncio.gather(*clients, return_exceptions=True)
+        answers = ledger["answers"]
+        metrics["submitted"] = ledger["submitted"]
+        metrics["answered"] = sum(1 for c in answers.values() if c >= 1)
+        metrics["answers_duplicated"] = sum(
+            c - 1 for c in answers.values() if c > 1
+        )
+        metrics["answers_lost"] = (
+            metrics["submitted"] - metrics["answered"]
+        )
+        metrics["poisoned_answers"] = ledger.get("poisoned", 0)
+        st = coord.stats
+        metrics["results_rejected"] = (
+            st["results_rejected"] - stats0["results_rejected"]
+        )
+        metrics["miners_evicted"] = (
+            st["miners_evicted"] - stats0["miners_evicted"]
+        )
+        metrics["chunks_requeued"] = (
+            st["chunks_requeued"] - stats0["chunks_requeued"]
+        )
+        return metrics
+    finally:
+        for t in clients + miners + byz:
+            t.cancel()
+        await asyncio.gather(
+            *clients, *miners, *byz, return_exceptions=True
+        )
+        serve.cancel()
+        await asyncio.gather(serve, return_exceptions=True)
+        await coord.close()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+async def _chaos_netsplit_cell(
+    seed: int,
+    *,
+    n_miners: int = 6,
+    n_clients: int = 2,
+    chunk_size: int = 1024,
+    chunks_per_job: Optional[int] = None,
+    params: Params = FAST,
+    pre: float = 1.0,
+    post: float = 1.5,
+    drain: float = 12.0,
+    binary: bool = True,
+    pipeline_depth: int = 2,
+) -> dict:
+    """The netsplit cell: a replicated primary+standby, and mid-burst
+    the primary↔standby link — and ONLY that link — goes dark (a
+    declarative ``FaultPlan.partition`` on the standby's endpoint; the
+    fleet keeps talking to the primary throughout). The standby detects
+    the silence and promotes: a SPLIT BRAIN, two live coordinators.
+    The netsplit heals right after promotion, the old primary's
+    shipping lane gets fenced off by the promoted standby, and — the
+    ISSUE 12 containment fix — the fenced lane now fences the WHOLE old
+    coordinator, which drops its fleet so everyone rotates onto the
+    promoted standby. The cell asserts the containment end-to-end plus
+    the exactly-once ledger across the whole ordeal."""
+    import shutil
+
+    from tpuminter.chaos import FaultPlan
+    from tpuminter.replication import ReplicationStandby
+
+    tmpdir = tempfile.mkdtemp(prefix="tpuminter-netsplit-")
+    standby = await ReplicationStandby.create(
+        os.path.join(tmpdir, "standby.wal"), params=params
+    )
+    standby_task = asyncio.ensure_future(standby.run())
+    coord = await make_coordinator(
+        params=params, chunk_size=chunk_size,
+        recover_from=os.path.join(tmpdir, "primary.wal"),
+        binary_codec=binary, pipeline_depth=pipeline_depth,
+        replicate_to=[("127.0.0.1", standby.port)], replica_ack=True,
+    )
+    ports = [coord.port, standby.port]
+    serve = asyncio.ensure_future(coord.serve())
+    if chunks_per_job is None:
+        chunks_per_job = max(8, 2 * n_miners)
+    upper = chunk_size * chunks_per_job - 1
+    ledger = {"answers": {}, "submitted": 0, "stop": False, "poisoned": 0}
+    miners = [
+        asyncio.ensure_future(_resilient_instant_miner(
+            ports, params, seed * 100 + i, binary=binary
+        ))
+        for i in range(n_miners)
+    ]
+    clients = [
+        asyncio.ensure_future(_durable_client_loop(
+            ports, params, i, upper, ledger, verify=True
+        ))
+        for i in range(n_clients)
+    ]
+    metrics: dict = {
+        "cell": "netsplit", "cell_seed": seed, "fleet": n_miners,
+        "clients": n_clients,
+    }
+    coord2 = None
+    serve2 = None
+    try:
+        await asyncio.sleep(pre)
+        metrics["replicated_records_pre_split"] = (
+            standby.stats["records_applied"]
+        )
+        # -- the link dies: one declarative rule, nothing else changes --
+        plan = FaultPlan(seed).partition(peer="*", direction="both")
+        standby.server.endpoint.set_fault_plan(plan)
+        t_split = time.monotonic()
+        await asyncio.wait_for(
+            standby.primary_lost.wait(),
+            10 * params.epoch_limit * params.epoch_seconds,
+        )
+        metrics["detect_ms"] = round(
+            (time.monotonic() - t_split) * 1e3, 1
+        )
+        # -- the standby promotes: split brain, two live coordinators --
+        coord2 = await standby.promote(
+            chunk_size=chunk_size, binary_codec=binary,
+            pipeline_depth=pipeline_depth,
+        )
+        serve2 = asyncio.ensure_future(coord2.serve())
+        metrics["promoted_epoch"] = coord2.boot_epoch
+        # -- the netsplit heals --
+        plan.heal()
+        t_heal = time.monotonic()
+        metrics["netsplit_ms"] = round((t_heal - t_split) * 1e3, 1)
+        # the old primary's shipping lane redials the promoted standby,
+        # gets its epoch fenced off, and (the ISSUE 12 fix) fences the
+        # whole old coordinator — without it the split brain persists
+        while not coord.fenced and time.monotonic() - t_heal < 15.0:
+            await asyncio.sleep(0.01)
+        metrics["old_primary_fenced"] = coord.fenced
+        metrics["fence_ms"] = round(
+            (time.monotonic() - t_heal) * 1e3, 1
+        )
+        # fleet lands on the promoted coordinator (first dispatch)
+        while coord2._next_chunk_id == 1:
+            if time.monotonic() - t_heal > 15.0:
+                break
+            await asyncio.sleep(0.005)
+        metrics["takeover_ms"] = round(
+            (time.monotonic() - t_split) * 1e3, 1
+        )
+        await asyncio.sleep(post)
+        ledger["stop"] = True
+        done, pending_tasks = await asyncio.wait(clients, timeout=drain)
+        for t in pending_tasks:
+            t.cancel()
+        await asyncio.gather(*clients, return_exceptions=True)
+        answers = ledger["answers"]
+        metrics["submitted"] = ledger["submitted"]
+        metrics["answered"] = sum(1 for c in answers.values() if c >= 1)
+        metrics["answers_duplicated"] = sum(
+            c - 1 for c in answers.values() if c > 1
+        )
+        metrics["answers_lost"] = (
+            metrics["submitted"] - metrics["answered"]
+        )
+        metrics["poisoned_answers"] = ledger.get("poisoned", 0)
+        metrics["fenced_rejections"] = (
+            coord2.stats["replication_fenced"]
+        )
+        return metrics
+    finally:
+        standby_task.cancel()
+        for t in clients + miners:
+            t.cancel()
+        await asyncio.gather(
+            standby_task, *clients, *miners, return_exceptions=True
+        )
+        serve.cancel()
+        await asyncio.gather(serve, return_exceptions=True)
+        await coord.close()
+        if serve2 is not None:
+            serve2.cancel()
+            await asyncio.gather(serve2, return_exceptions=True)
+        if coord2 is not None:
+            await coord2.close()
+        elif not standby.promoted:
+            await standby.close()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+async def run_chaos(
+    cells=None,
+    *,
+    seed: int = 0,
+    n_miners: int = 6,
+    n_clients: int = 2,
+    duration: float = 1.2,
+    params: Params = FAST,
+    binary: bool = True,
+    pipeline_depth: int = 2,
+) -> dict:
+    """Sweep the chaos matrix: run each named cell with a seed derived
+    from (``seed``, cell index) — the whole grid of fault draws and
+    partition windows is reproducible from ``--seed`` — and return the
+    per-cell metrics. ``chaos_check`` holds the assertions."""
+    if cells is None:
+        cells = CHAOS_CELLS
+    out: dict = {"seed": seed, "cells": list(cells), "results": {}}
+    for i, cell in enumerate(cells):
+        cell_seed = (seed * 1000003 + i * 101) & 0x7FFFFFFF
+        if cell == "netsplit":
+            m = await _chaos_netsplit_cell(
+                cell_seed, n_miners=n_miners, n_clients=n_clients,
+                params=params, pre=min(duration, 1.0), post=duration,
+                binary=binary, pipeline_depth=pipeline_depth,
+            )
+        else:
+            m = await _chaos_fleet_cell(
+                cell, cell_seed, n_miners=n_miners, n_clients=n_clients,
+                params=params, pre=min(duration, 0.8), fault=duration,
+                post=min(duration, 1.0), binary=binary,
+                pipeline_depth=pipeline_depth,
+            )
+        out["results"][cell] = m
+    return out
+
+
+def chaos_check(metrics: dict, params: Params = FAST) -> list:
+    """The matrix's pass/fail assertions, applied after EVERY cell (the
+    tier-1 gate shape): the exactly-once ledger holds under every
+    degradation, forged answers never reach a client, byzantine actors
+    are evicted and their chunks re-mined, a netsplit's split brain is
+    contained, and disk faults degrade exactly as designed."""
+    bad = []
+    for cell, m in metrics.get("results", {}).items():
+        pre = f"[{cell}] "
+        if m.get("answered", 0) <= 0:
+            bad.append(pre + f"no requests answered at all: {m}")
+        if m.get("answers_duplicated", 0) > 0:
+            bad.append(
+                pre + f"{m['answers_duplicated']} duplicate answer(s): "
+                f"the exactly-once ledger broke"
+            )
+        if m.get("answers_lost", 0) > 0:
+            bad.append(
+                pre + f"{m['answers_lost']} request(s) never answered "
+                f"despite the post-heal drain window"
+            )
+        if m.get("poisoned_answers", 0) > 0:
+            bad.append(
+                pre + f"{m['poisoned_answers']} FORGED answer(s) "
+                f"reached a client — byzantine containment broke"
+            )
+        if cell == "netsplit":
+            if m.get("replicated_records_pre_split", 0) <= 0:
+                bad.append(
+                    pre + "no records replicated before the split: the "
+                    "cell measured an empty takeover"
+                )
+            if not m.get("old_primary_fenced"):
+                bad.append(
+                    pre + "the old primary kept serving after the heal "
+                    "— split brain uncontained"
+                )
+            if m.get("takeover_ms", 1e9) > 20_000:
+                bad.append(
+                    pre + f"takeover took {m.get('takeover_ms')} ms: "
+                    f"the fleet never landed on the promoted standby"
+                )
+        elif cell == "byzantine":
+            if m.get("miners_evicted", 0) <= 0:
+                bad.append(pre + "no byzantine miner was evicted")
+            if m.get("results_rejected", 0) <= 0:
+                bad.append(pre + "no forged result was rejected")
+            if m.get("chunks_requeued", 0) <= 0:
+                bad.append(pre + "no poisoned chunk was requeued")
+        elif cell == "delay_reorder":
+            if m.get("miners_evicted", 0) > 0:
+                bad.append(
+                    pre + "transport faults alone got a miner evicted "
+                    "— duplicate/reordered datagrams read as byzantine"
+                )
+        elif cell == "fsync_stall":
+            if not m.get("fsync_slow_flipped"):
+                bad.append(
+                    pre + "a 20 ms fsync stall never tripped the "
+                    "slow-fsync executor fallback"
+                )
+            if m.get("journal_failed"):
+                bad.append(
+                    pre + "a slow disk must degrade latency, not kill "
+                    "the journal"
+                )
+        elif cell == "enospc":
+            if not m.get("journal_failed"):
+                bad.append(
+                    pre + "ENOSPC did not trip the journal's loud "
+                    "availability-over-durability path"
+                )
+    return bad
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="tpuminter control-plane load generator"
@@ -1246,7 +1817,7 @@ def main(argv=None) -> int:
         "or a fleet that fails to resume)",
     )
     parser.add_argument(
-        "--scenario", choices=("steady", "crash", "failover"),
+        "--scenario", choices=("steady", "crash", "failover", "chaos"),
         default="steady",
         help="steady: the sustained-burst benchmark; crash: kill the "
         "journaled coordinator mid-burst, restart it from the journal "
@@ -1255,7 +1826,18 @@ def main(argv=None) -> int:
         "to a live hot standby, dies mid-burst WITHOUT its journal "
         "ever being re-read, the standby promotes with a fenced epoch "
         "and the address-listed fleet lands on it — reports "
-        "detect/takeover/blackout latency plus the same ledger",
+        "detect/takeover/blackout latency plus the same ledger; "
+        "chaos: sweep the deterministic fault-plan matrix (netsplit, "
+        "asymmetric loss, delay/reorder, fsync stall, ENOSPC, "
+        "byzantine fleet) and assert the exactly-once ledger plus "
+        "containment after every cell — --smoke runs the netsplit + "
+        "byzantine subset (the tier-1 gate), --seed picks the grid",
+    )
+    parser.add_argument(
+        "--seed", type=int, default=0,
+        help="chaos scenario: the fault-plan grid seed — every cell's "
+        "drop/dup/delay draws and partition windows derive from it, so "
+        "a failing matrix replays cell-for-cell",
     )
     parser.add_argument(
         "--journal", metavar="PATH", default=None,
@@ -1336,6 +1918,28 @@ def main(argv=None) -> int:
         binary=args.codec == "binary", pipeline_depth=args.pipeline,
         loops=args.loops, io_batch=args.io_batch == "on",
     )
+    if args.scenario == "chaos":
+        cells = CHAOS_SMOKE_CELLS if args.smoke else CHAOS_CELLS
+        metrics = asyncio.run(run_chaos(
+            cells, seed=args.seed, n_miners=min(args.miners, 8),
+            n_clients=max(2, args.clients // 2),
+            duration=min(args.duration, 1.2) if args.smoke
+            else args.duration,
+            binary=args.codec == "binary",
+            pipeline_depth=args.pipeline,
+        ))
+        print(json.dumps(metrics) if args.json else
+              "\n".join(
+                  f"{cell}.{k}: {v}"
+                  for cell, m in metrics["results"].items()
+                  for k, v in m.items()
+              ))
+        # the matrix IS its assertions: check after every cell whether
+        # or not --smoke asked (a chaos run that doesn't gate is noise)
+        violations = chaos_check(metrics)
+        for v in violations:
+            print(f"CHAOS FAIL: {v}", file=sys.stderr)
+        return 1 if violations else 0
     if args.scenario == "failover":
         if args.smoke:
             # 2+ loops need a fleet big enough that an empty shard is

@@ -1,0 +1,404 @@
+"""Chaos-plan unit + seam tests (ISSUE 12).
+
+Covers the pieces underneath ``loadgen --scenario chaos`` one layer at
+a time, so a matrix failure localizes:
+
+- :class:`tpuminter.chaos.FaultPlan` semantics in isolation — partition
+  windows and heal, per-direction and per-peer matching with
+  most-specific-wins, verdict shapes, determinism from the seed;
+- the transport seam: a plan installed on a live ``UdpEndpoint``
+  actually blacks out / duplicates datagrams and books the counters;
+- :class:`tpuminter.chaos.DiskFaultPlan` through ``Journal._write_sync``:
+  a torn-tail write is truncated by the next ``Journal.open`` scan, a
+  one-shot ENOSPC trips the loud availability-over-durability path
+  (callbacks still fire), an fsync stall flips the sticky slow-fsync
+  executor fallback without killing the journal;
+- ``lsp.params.jittered_backoff`` properties, deterministically (the
+  hypothesis variants live in test_properties.py and only run where
+  hypothesis is installed): jitter bounds, the cap ceiling, and that
+  all four production redial loops respect the ceiling under a long
+  total partition (every dial refused).
+"""
+
+import asyncio
+import os
+import random
+import sys
+
+import pytest
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+    ),
+)
+
+import loadgen  # noqa: E402  (scripts/ is not a package)
+
+from tpuminter.chaos import (  # noqa: E402
+    DELIVER,
+    DROP,
+    DiskFaultPlan,
+    FaultPlan,
+)
+from tpuminter.client import submit  # noqa: E402
+from tpuminter.journal import Journal, scan  # noqa: E402
+from tpuminter.lsp import LspClient, LspConnectError  # noqa: E402
+from tpuminter.lsp.params import FAST, jittered_backoff  # noqa: E402
+from tpuminter.lsp.transport import UdpEndpoint  # noqa: E402
+from tpuminter.protocol import PowMode, Request  # noqa: E402
+from tpuminter.worker import CpuMiner, run_miner_reconnect  # noqa: E402
+
+
+def run(coro, timeout=30.0):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+A1 = ("127.0.0.1", 9401)
+A2 = ("127.0.0.1", 9402)
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan semantics (pure: no sockets, no clock — `now` injected)
+# ---------------------------------------------------------------------------
+
+def test_partition_window_and_heal():
+    plan = FaultPlan(0).partition(peer=9401, start=1.0, duration=2.0)
+    plan.arm(now=100.0)
+    # before the window opens the link is clean
+    assert not plan.partitioned("in", A1, now=100.5)
+    assert plan.decide("in", A1, now=100.5) is None
+    # inside the window: total blackout, both directions by default
+    assert plan.decide("in", A1, now=101.5) == (DROP, "partition")
+    assert plan.decide("out", A1, now=102.9) == (DROP, "partition")
+    assert plan.stats["partitioned"] == 2
+    # the window closes on its own
+    assert plan.decide("in", A1, now=103.1) is None
+    # heal() ends an open-ended partition early
+    plan2 = FaultPlan(0).partition(peer=9401)  # duration=None: no self-heal
+    plan2.arm(now=0.0)
+    assert plan2.decide("in", A1, now=1e6) == (DROP, "partition")
+    plan2.heal()
+    assert plan2.decide("in", A1, now=1e6) is None
+
+
+def test_partition_direction_and_peer_matching():
+    plan = FaultPlan(0).partition(peer=9401, direction="in")
+    plan.arm(now=0.0)
+    # matched peer, matched direction only
+    assert plan.decide("in", A1, now=1.0) == (DROP, "partition")
+    assert plan.decide("out", A1, now=1.0) is None
+    # other peers unaffected (bare-port spec matches any host)
+    assert plan.decide("in", A2, now=1.0) is None
+    assert plan.partitioned("in", ("10.0.0.9", 9401), now=1.0)
+
+
+def test_rule_specificity_most_specific_wins():
+    plan = (
+        FaultPlan(1)
+        .link(peer="*", drop=1.0)
+        .link(peer=A1, drop=0.0)
+    )
+    plan.arm(now=0.0)
+    # exact-address rule (drop=0) shadows the wildcard for A1 ...
+    kind, delays = plan.decide("in", A1)
+    assert kind == DELIVER and delays == [0]
+    # ... while everyone else eats the wildcard's certain drop
+    assert plan.decide("in", A2) == (DROP, "rate")
+    assert plan.decide("out", ("10.0.0.9", 1234)) == (DROP, "rate")
+
+
+def test_no_match_falls_through_to_endpoint_rates():
+    plan = FaultPlan(2).link(peer=9999, drop=1.0)
+    plan.arm(now=0.0)
+    assert plan.decide("in", A1) is None  # port 9401 != 9999
+    assert plan.stats["passed"] == 0
+
+
+def test_verdict_shapes_dup_delay_reorder():
+    plan = FaultPlan(3).link(
+        peer="*", dup=1.0, reorder=1.0, reorder_delay=0.5,
+        delay=0.01, delay_jitter=0.005,
+    )
+    plan.arm(now=0.0)
+    kind, delays = plan.decide("in", A1)
+    assert kind == DELIVER
+    assert len(delays) == 2  # certain dup: two copies
+    for held in delays:
+        # delay + U[0, jitter) + certain reorder_delay
+        assert 0.51 <= held < 0.515
+    assert plan.stats["duplicated"] == 1
+    assert plan.stats["delayed"] == 2
+
+
+def test_plan_is_deterministic_from_seed():
+    def drive(plan):
+        plan.arm(now=0.0)
+        return [
+            plan.decide("in" if i % 2 else "out", A1 if i % 3 else A2)
+            for i in range(200)
+        ]
+
+    mk = lambda s: FaultPlan(s).link(  # noqa: E731
+        peer="*", drop=0.2, dup=0.2, reorder=0.2, delay_jitter=0.01
+    )
+    a, b = drive(mk(42)), drive(mk(42))
+    assert a == b
+    assert drive(mk(43)) != a  # and the seed actually matters
+
+
+def test_invalid_specs_rejected_loudly():
+    with pytest.raises(ValueError):
+        FaultPlan(0).link(peer="*", direction="sideways")
+    with pytest.raises(ValueError):
+        FaultPlan(0).partition(peer="anyone")  # only "*" as a string
+    with pytest.raises((TypeError, ValueError)):
+        FaultPlan(0).link(peer=("h", 1, 2))  # not a 2-tuple
+
+
+# ---------------------------------------------------------------------------
+# the transport seam: a plan on a live endpoint
+# ---------------------------------------------------------------------------
+
+def test_endpoint_partition_blocks_then_heals():
+    async def scenario():
+        got = []
+        server = await UdpEndpoint.create(
+            lambda d, a: got.append(bytes(d)), local_addr=("127.0.0.1", 0)
+        )
+        sender = await UdpEndpoint.create(lambda d, a: None)
+        try:
+            addr = server.local_addr
+            plan = FaultPlan(7).partition(peer="*", direction="in")
+            server.set_fault_plan(plan)
+            for i in range(10):
+                sender.send(b"x%d" % i, addr)
+            await asyncio.sleep(0.1)
+            assert got == []
+            assert server.partitioned_in == 10
+            assert plan.stats["partitioned"] == 10
+            plan.heal()
+            sender.send(b"after", addr)
+            await asyncio.sleep(0.1)
+            assert got == [b"after"]
+        finally:
+            server.close()
+            sender.close()
+            await server.wait_closed()
+            await sender.wait_closed()
+
+    run(scenario())
+
+
+def test_endpoint_outbound_plan_drops_and_duplicates():
+    async def scenario():
+        got = []
+        server = await UdpEndpoint.create(
+            lambda d, a: got.append(bytes(d)), local_addr=("127.0.0.1", 0)
+        )
+        sender = await UdpEndpoint.create(lambda d, a: None)
+        try:
+            addr = server.local_addr
+            # certain duplication on the way OUT of the sender
+            plan = FaultPlan(7).link(peer="*", direction="out", dup=1.0)
+            sender.set_fault_plan(plan)
+            sender.send(b"twice", addr)
+            await asyncio.sleep(0.1)
+            assert got == [b"twice", b"twice"]
+            # outbound partition: nothing leaves, counter books it
+            sender.set_fault_plan(
+                FaultPlan(7).partition(peer="*", direction="out")
+            )
+            sender.send(b"never", addr)
+            await asyncio.sleep(0.1)
+            assert b"never" not in got
+            assert sender.partitioned_out == 1
+        finally:
+            server.close()
+            sender.close()
+            await server.wait_closed()
+            await sender.wait_closed()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# the disk seam: DiskFaultPlan through Journal._write_sync
+# ---------------------------------------------------------------------------
+
+def test_torn_tail_write_truncated_on_reopen(tmp_path):
+    path = str(tmp_path / "wal")
+    j, _ = Journal.open(path, fsync=False)
+    j.append("note", {"v": 1})  # no loop: written through synchronously
+    clean_size = j.size
+    j.fault_plan = DiskFaultPlan(torn_tail_once=True)
+    # the torn write persists half the record then dies like a power cut;
+    # with no loop running the append path surfaces the OSError directly
+    with pytest.raises(OSError):
+        j.append("note", {"v": 2})
+    assert j.fault_plan.stats["torn_writes"] == 1
+    j.crash()
+    with open(path, "rb") as fh:
+        data = fh.read()
+    assert len(data) > clean_size  # the torn half really hit the disk
+    records, clean = scan(data)
+    assert clean == clean_size  # scan stops exactly at the clean prefix
+    # reopen: the scan truncates the torn tail in place and the journal
+    # carries on from a clean file (plus its new boot record)
+    j2, state = Journal.open(path, fsync=False)
+    assert state.boot_epoch == 2
+    with open(path, "rb") as fh:
+        data2 = fh.read()
+    records2, clean2 = scan(data2)
+    assert clean2 == len(data2)  # nothing unreadable remains
+    j2.crash()
+
+
+def test_enospc_trips_loud_undurable_path_but_replies_flow(tmp_path):
+    async def scenario():
+        j, _ = Journal.open(str(tmp_path / "wal"))
+        plan = DiskFaultPlan(enospc_once=True)
+        j.fault_plan = plan
+        fired = asyncio.Event()
+        j.append("note", {"v": 1}, on_durable=fired.set)
+        # availability over durability: the reply gate opens even though
+        # the write died on the floor
+        await asyncio.wait_for(fired.wait(), 5.0)
+        assert j._failed
+        assert plan.stats["enospc"] == 1
+        # later appends short-circuit, but their callbacks still fire —
+        # a dead WAL must never wedge a client reply
+        fired2 = asyncio.Event()
+        j.append("note", {"v": 2}, on_durable=fired2.set)
+        assert fired2.is_set()
+        j.crash()
+
+    run(scenario())
+
+
+def test_fsync_stall_flips_sticky_executor_fallback(tmp_path):
+    async def scenario():
+        j, _ = Journal.open(str(tmp_path / "wal"))
+        plan = DiskFaultPlan(fsync_stall_s=0.01)  # > INLINE_FSYNC_BUDGET_S
+        j.fault_plan = plan
+        assert not j._fsync_slow
+        fired = asyncio.Event()
+        j.append("note", {"v": 1}, on_durable=fired.set)
+        await asyncio.wait_for(fired.wait(), 5.0)
+        # the stalled inline fsync trips the sticky flag ...
+        assert j._fsync_slow
+        assert not j._failed
+        # ... and the next durable batch (executor tier now) still lands
+        fired2 = asyncio.Event()
+        j.append("note", {"v": 2}, on_durable=fired2.set)
+        await asyncio.wait_for(fired2.wait(), 5.0)
+        assert plan.stats["stalls"] == 2
+        assert not j._failed
+        j.crash()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# jittered_backoff properties, deterministically (hypothesis-free)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [0, 1, 7, 99])
+@pytest.mark.parametrize("base,cap", [(0.05, 1.0), (0.2, 5.0), (0.1, 2.0)])
+def test_backoff_jitter_bounds_and_cap(seed, base, cap):
+    gen = jittered_backoff(base, cap, random.Random(seed))
+    unjittered = base
+    for _ in range(60):
+        got = next(gen)
+        # each yield is the current envelope value under [0.5, 1.5) jitter
+        assert unjittered * 0.5 <= got < unjittered * 1.5
+        # the hard ceiling no draw may ever exceed
+        assert got < cap * 1.5
+        unjittered = min(unjittered * 2, cap)
+    # the envelope actually reached the cap (monotone doubling saturates)
+    assert unjittered == cap
+
+
+def test_backoff_deterministic_from_seed():
+    gen1 = jittered_backoff(0.05, 1.0, random.Random(5))
+    gen2 = jittered_backoff(0.05, 1.0, random.Random(5))
+    gen3 = jittered_backoff(0.05, 1.0, random.Random(6))
+    seq1 = [next(gen1) for _ in range(30)]
+    assert seq1 == [next(gen2) for _ in range(30)]
+    assert seq1 != [next(gen3) for _ in range(30)]
+
+
+# ---------------------------------------------------------------------------
+# all four production redial loops respect the ceiling under a long
+# total partition (every dial refused, so each loop lives in its
+# backoff forever — no recorded wait may exceed cap * 1.5)
+# ---------------------------------------------------------------------------
+
+def test_all_redial_loops_respect_backoff_ceiling(monkeypatch):
+    real_sleep = asyncio.sleep
+
+    recorded = []
+
+    async def fake_sleep(delay, *args, **kwargs):
+        recorded.append(delay)
+        await real_sleep(0)
+
+    async def refuse_dial(*args, **kwargs):
+        raise LspConnectError("chaos: total partition")
+
+    monkeypatch.setattr(asyncio, "sleep", fake_sleep)
+    monkeypatch.setattr(LspClient, "connect", refuse_dial)
+
+    async def drain(task, want=20):
+        # the loops are unbounded: cancel once enough waits are recorded
+        while len(recorded) < want and not task.done():
+            await real_sleep(0)
+        task.cancel()
+        try:
+            await task
+        except (asyncio.CancelledError, LspConnectError):
+            pass
+
+    def check(cap, loop_name):
+        assert recorded, f"{loop_name}: no backoff waits recorded"
+        assert max(recorded) < cap * 1.5, (
+            f"{loop_name}: a wait exceeded the jittered ceiling"
+        )
+        # the envelope saturated at the cap (a real long partition)
+        assert max(recorded) >= cap * 0.5
+        recorded.clear()
+
+    async def scenario():
+        # 1. worker fleet redial loop (bounded natively via max_dials)
+        await run_miner_reconnect(
+            "127.0.0.1", 1, CpuMiner(), params=FAST,
+            base_backoff=0.05, max_backoff=1.0, max_dials=25,
+            rng=random.Random(0),
+        )
+        check(1.0, "worker.run_miner_reconnect")
+
+        # 2. durable client redial loop (client.submit reconnect=True)
+        req = Request(job_id=0, mode=PowMode.MIN, lower=0, upper=10,
+                      data=b"x")
+        await drain(asyncio.ensure_future(submit(
+            "127.0.0.1", 1, req, params=FAST, reconnect=True,
+            base_backoff=0.05, max_backoff=1.0, rng=random.Random(0),
+        )))
+        check(1.0, "client.submit")
+
+        # 3. loadgen resilient miner actor
+        await drain(asyncio.ensure_future(
+            loadgen._resilient_instant_miner([1], FAST, 0, binary=True)
+        ))
+        check(1.0, "loadgen._resilient_instant_miner")
+
+        # 4. loadgen durable client actor
+        ledger = {"answers": {}, "submitted": 0, "stop": False}
+        await drain(asyncio.ensure_future(
+            loadgen._durable_client_loop([1], FAST, 0, 50, ledger)
+        ))
+        check(1.0, "loadgen._durable_client_loop")
+
+    asyncio.run(asyncio.wait_for(scenario(), 60.0))

@@ -45,7 +45,7 @@ from tpuminter.protocol import (  # noqa: E402
     encode_msg,
 )
 
-from tests.test_e2e import FAST, Cluster, run  # noqa: E402
+from tests.test_e2e import FAST, Cluster, brute_min, run  # noqa: E402
 
 
 def test_loadgen_smoke_fleet64_sustains_without_stalls(capsys):
@@ -251,3 +251,114 @@ def test_client_timeout_flag_exits_cleanly(capsys):
     finally:
         stop["loop"].call_soon_threadsafe(stop["event"].set)
         t.join(10)
+
+
+def test_byzantine_eviction_requeues_and_job_finishes_exact():
+    """ISSUE 12 satellite: the byzantine-eviction path end-to-end. A
+    worker that answers every dispatch with a forged winner (plausible
+    shape, wrong hash) accumulates verifier rejections until eviction
+    (``miners_evicted``), its poisoned chunks are requeued, NO forged
+    answer ever reaches the client, and an honest miner added after the
+    eviction finishes the job with the brute-force-exact minimum."""
+
+    async def scenario():
+        from tpuminter.coordinator import MAX_REJECTIONS
+        from tpuminter.worker import CpuMiner
+
+        cluster = await Cluster.create(n_miners=0, chunk_size=512)
+        try:
+            evil = await LspClient.connect(
+                "127.0.0.1", cluster.coord.port, FAST
+            )
+            evil.write(encode_msg(Join(backend="evil", lanes=1)))
+
+            async def forge_forever():
+                templates = {}
+                try:
+                    while True:
+                        msg = decode_msg(await evil.read())
+                        if isinstance(msg, Setup):
+                            templates[msg.request.job_id] = msg.request
+                        elif isinstance(msg, Assign):
+                            req = templates.get(msg.job_id)
+                            if req is None:
+                                continue
+                            evil.write(encode_msg(Result(
+                                msg.job_id, req.mode, nonce=msg.lower,
+                                hash_value=(
+                                    chain.toy_hash(req.data, msg.upper) ^ 1
+                                ),
+                                found=True,
+                                searched=msg.upper - msg.lower + 1,
+                                chunk_id=msg.chunk_id,
+                            )))
+                except LspConnectionLost:
+                    pass  # evicted: exactly the point
+
+            evil_task = asyncio.ensure_future(forge_forever())
+            data, upper = b"byzantine-e2e", 4000
+            req = Request(job_id=0, mode=PowMode.MIN, lower=0,
+                          upper=upper, data=data)
+            submit_task = asyncio.ensure_future(
+                submit("127.0.0.1", cluster.coord.port, req, params=FAST)
+            )
+            for _ in range(200):  # ≤ 10 s for the eviction to land
+                if cluster.coord.stats["miners_evicted"] >= 1:
+                    break
+                await asyncio.sleep(0.05)
+            stats = cluster.coord.stats
+            assert stats["miners_evicted"] == 1
+            assert stats["results_rejected"] >= MAX_REJECTIONS
+            assert stats["chunks_requeued"] >= 1
+            # containment: no forged Result escaped to the client
+            assert not submit_task.done()
+            await cluster.add_miner(CpuMiner())
+            result = await asyncio.wait_for(submit_task, 60.0)
+            assert (result.hash_value, result.nonce) == brute_min(
+                data, 0, upper
+            )
+            evil_task.cancel()
+            await asyncio.gather(evil_task, return_exceptions=True)
+            await evil.close(drain_timeout=0.2)
+        finally:
+            await cluster.close()
+
+    run(scenario(), timeout=120.0)
+
+
+def test_loadgen_chaos_smoke_gate(capsys):
+    """The tier-1 chaos gate (ISSUE 12 satellite): ``--scenario chaos
+    --smoke`` runs the netsplit + byzantine cells with the full
+    ``chaos_check`` assertions behind rc — exactly-once ledger, split
+    brain contained, forged answers contained, offenders evicted —
+    reproducible from ``--seed``."""
+    import json as _json
+
+    rc = loadgen.main([
+        "--scenario", "chaos", "--smoke", "--seed", "3",
+        "--miners", "6", "--clients", "4", "--duration", "1.0", "--json",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, f"chaos smoke gate failed: {out}"
+    metrics = _json.loads(out.splitlines()[0])
+    assert metrics["seed"] == 3
+    assert metrics["cells"] == ["netsplit", "byzantine"]
+    ns = metrics["results"]["netsplit"]
+    # the exactly-once ledger held across the split (chaos_check
+    # enforces the same behind rc; re-asserted so a loosened check
+    # cannot silently drop the criteria)
+    assert ns["answered"] > 0
+    assert ns["answers_lost"] == 0
+    assert ns["answers_duplicated"] == 0
+    assert ns["poisoned_answers"] == 0
+    assert ns["replicated_records_pre_split"] > 0
+    assert ns["old_primary_fenced"] is True
+    assert ns["takeover_ms"] <= 20_000
+    bz = metrics["results"]["byzantine"]
+    assert bz["answered"] > 0
+    assert bz["answers_lost"] == 0
+    assert bz["answers_duplicated"] == 0
+    assert bz["poisoned_answers"] == 0
+    assert bz["miners_evicted"] > 0
+    assert bz["results_rejected"] > 0
+    assert bz["chunks_requeued"] > 0

@@ -110,10 +110,18 @@ def test_scheduler_fuzz_exact_answers_despite_hostile_fleet(seed, monkeypatch):
             n_miners=0, chunk_size=600,
             hedge_after=0.4, audit_rate=1.0, audit_seed=seed,
         )
-        # transport faults on top of everything else
-        ep = cluster.coord._server.endpoint
-        ep.set_fault_rates(drop=0.05, dup=0.05, reorder=0.05)
-        ep.reorder_delay = 0.01
+        # transport faults on top of everything else — expressed as a
+        # chaos FaultPlan (ISSUE 12) so fuzz and the loadgen chaos
+        # matrix share one seeded fault vocabulary; a wildcard link
+        # rule in both directions is exactly the old uniform rates
+        from tpuminter.chaos import FaultPlan
+
+        cluster.coord._server.endpoint.set_fault_plan(
+            FaultPlan(seed).link(
+                peer="*", direction="both", drop=0.05, dup=0.05,
+                reorder=0.05, reorder_delay=0.01,
+            )
+        )
         actors = []
 
         def spawn(behavior):

@@ -694,3 +694,67 @@ def test_codec_checker_clean_table_stays_clean(kinds):
         k["variable"] = False
         k["has_crc"] = True
     assert check_table(kinds) == []
+
+
+# ---------------------------------------------------------------------------
+# jittered_backoff (lsp.params): the redial-delay contract every
+# reconnect loop leans on under a long partition (deterministic mirrors
+# live in tests/test_chaos.py — this image lacks hypothesis)
+# ---------------------------------------------------------------------------
+
+from tpuminter.lsp.params import jittered_backoff  # noqa: E402
+
+
+@settings(max_examples=120)
+@given(
+    base=st.floats(0.001, 2.0),
+    factor=st.floats(1.0, 64.0),
+    seed=st.integers(0, 2**32),
+    n=st.integers(1, 64),
+)
+def test_backoff_every_draw_within_jittered_envelope(base, factor, seed, n):
+    """Each draw is the doubling envelope value ``min(base·2^k, cap)``
+    under a uniform [0.5, 1.5) jitter — so no wait ever exceeds
+    ``cap · 1.5``, the ceiling bounding every redial loop's patience,
+    and no wait collapses below half the envelope (lockstep-free but
+    never a hot spin)."""
+    cap = base * factor
+    gen = jittered_backoff(base, cap, random.Random(seed))
+    envelope = base
+    for _ in range(n):
+        got = next(gen)
+        assert envelope * 0.5 <= got <= envelope * 1.5
+        assert got <= cap * 1.5
+        # the unjittered envelope is monotone and capped — the next
+        # draw's bounds can only move up, never past the cap
+        envelope = min(envelope * 2, cap)
+        assert envelope <= cap
+
+
+@settings(max_examples=80)
+@given(
+    base=st.floats(0.001, 2.0),
+    factor=st.floats(1.0, 64.0),
+    seed=st.integers(0, 2**32),
+)
+def test_backoff_saturates_at_cap_and_is_seed_deterministic(
+    base, factor, seed
+):
+    """After ``ceil(log2(cap/base))`` doublings every draw comes from
+    the capped regime ``[cap/2, cap·1.5]`` — a partition that outlives
+    the ramp gets a steady bounded redial cadence, not unbounded growth
+    — and the whole sequence replays from the rng seed."""
+    import math
+
+    cap = base * factor
+    ramp = max(0, math.ceil(math.log2(max(factor, 1.0)))) + 1
+    gen = jittered_backoff(base, cap, random.Random(seed))
+    for _ in range(ramp):
+        next(gen)
+    tail = [next(gen) for _ in range(20)]
+    assert all(cap * 0.5 <= d <= cap * 1.5 for d in tail)
+    gen_a = jittered_backoff(base, cap, random.Random(seed))
+    gen_b = jittered_backoff(base, cap, random.Random(seed))
+    assert [next(gen_a) for _ in range(30)] == [
+        next(gen_b) for _ in range(30)
+    ]

@@ -1,0 +1,366 @@
+"""Deterministic chaos plans: declarative fault schedules for the three
+seams the stack owns (ISSUE 12).
+
+The transport seam (``lsp/transport.py``) has had seeded *uniform* fault
+rates since the seed — every peer, both directions, one knob. Real
+degradations are not uniform: a netsplit cuts exactly one link for a
+window and then heals; asymmetric loss eats A→B while B→A flows; a slow
+disk stalls fsync without dropping a single datagram. This module turns
+those into *plans* — declarative, seeded, reproducible rules — that the
+seams consult:
+
+- :class:`FaultPlan` — per-link, per-direction datagram faults
+  (drop/dup/reorder/delay distributions) plus time-windowed
+  **partitions** with heal. Installed on a ``UdpEndpoint`` via
+  ``endpoint.set_fault_plan(plan)``; a matching rule *overrides* the
+  endpoint's global rates for that datagram, no match falls through.
+- :class:`DiskFaultPlan` — journal write/fsync faults (fsync stalls of
+  configurable duration, one-shot ENOSPC, torn-tail writes). Installed
+  as ``journal.fault_plan``; consulted inside ``Journal._write_sync``,
+  the single disk choke point.
+
+Determinism: each plan owns one ``random.Random(seed)``. Given the same
+seed and the same datagram order, every draw is identical — the
+``loadgen --scenario chaos`` matrix replays cell-for-cell from
+``--seed``. Plans are cheap value objects; building one never touches a
+clock or a socket. Time-windowed rules (partitions) measure from
+:meth:`FaultPlan.arm` (called automatically on install) using
+``time.monotonic()``.
+
+Peer specs, most-specific match wins:
+
+- ``(host, port)`` tuple — exactly one remote address
+- ``port`` (int) — any host, that port (handy on localhost where every
+  actor is 127.0.0.1 and the port *is* the identity)
+- ``"*"`` — every peer
+
+Example — a 0.8 s netsplit between this endpoint and the standby at
+port 9401, plus mild asymmetric inbound loss from everyone else::
+
+    plan = (
+        FaultPlan(seed=7)
+        .partition(peer=9401, start=0.2, duration=0.8)
+        .link(peer="*", direction="in", drop=0.05)
+    )
+    endpoint.set_fault_plan(plan)
+"""
+
+from __future__ import annotations
+
+import errno
+import random
+import time
+from typing import List, Optional, Tuple, Union
+
+Addr = Tuple[str, int]
+#: a peer selector: exact address, bare port, or "*" for everyone
+PeerSpec = Union[str, int, Addr]
+
+#: direction tokens, from the endpoint's point of view: "in" = datagrams
+#: arriving at this endpoint, "out" = datagrams it sends
+DIRECTIONS = ("in", "out", "both")
+
+#: verdict kinds returned by :meth:`FaultPlan.decide`
+DROP = "drop"
+DELIVER = "deliver"
+
+
+def _norm_peer(peer: PeerSpec) -> PeerSpec:
+    if isinstance(peer, str):
+        if peer != "*":
+            raise ValueError(f"string peer spec must be '*', got {peer!r}")
+        return peer
+    if isinstance(peer, int):
+        return peer
+    host, port = peer  # unpacking enforces the 2-tuple shape
+    return (host, int(port))
+
+
+def _peer_specificity(peer: PeerSpec) -> int:
+    """Exact addr (2) beats bare port (1) beats wildcard (0)."""
+    if isinstance(peer, tuple):
+        return 2
+    if isinstance(peer, int):
+        return 1
+    return 0
+
+
+def _peer_matches(peer: PeerSpec, addr: Addr) -> bool:
+    if peer == "*":
+        return True
+    if isinstance(peer, int):
+        return addr[1] == peer
+    return tuple(peer) == tuple(addr)
+
+
+def _dir_matches(rule_dir: str, direction: str) -> bool:
+    return rule_dir == "both" or rule_dir == direction
+
+
+class LinkRule:
+    """One per-link fault distribution (see :meth:`FaultPlan.link`)."""
+
+    __slots__ = (
+        "peer", "direction", "drop", "dup", "reorder",
+        "reorder_delay", "delay", "delay_jitter",
+    )
+
+    def __init__(
+        self,
+        peer: PeerSpec,
+        direction: str,
+        drop: float,
+        dup: float,
+        reorder: float,
+        reorder_delay: float,
+        delay: float,
+        delay_jitter: float,
+    ):
+        self.peer = peer
+        self.direction = direction
+        self.drop = drop
+        self.dup = dup
+        self.reorder = reorder
+        self.reorder_delay = reorder_delay
+        self.delay = delay
+        self.delay_jitter = delay_jitter
+
+
+class Partition:
+    """A time-windowed total blackout of one link (see
+    :meth:`FaultPlan.partition`). ``duration=None`` never heals on its
+    own — only :meth:`FaultPlan.heal` lifts it."""
+
+    __slots__ = ("peer", "direction", "start", "duration", "healed")
+
+    def __init__(
+        self,
+        peer: PeerSpec,
+        direction: str,
+        start: float,
+        duration: Optional[float],
+    ):
+        self.peer = peer
+        self.direction = direction
+        self.start = start
+        self.duration = duration
+        self.healed = False
+
+    def active(self, elapsed: float) -> bool:
+        if self.healed or elapsed < self.start:
+            return False
+        if self.duration is None:
+            return True
+        return elapsed < self.start + self.duration
+
+
+class FaultPlan:
+    """A declarative, seeded schedule of per-link datagram faults.
+
+    Builder methods (:meth:`link`, :meth:`partition`) return ``self`` so
+    plans read as one chained expression. A plan may be shared by
+    several endpoints (e.g. every shard of a multi-loop coordinator):
+    draws come from the one plan RNG, so the aggregate fault pattern is
+    a pure function of the seed and the datagram arrival order.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._rules: List[LinkRule] = []
+        self._partitions: List[Partition] = []
+        self._t0: Optional[float] = None
+        #: observability: what the plan actually did
+        self.stats = {
+            "partitioned": 0, "dropped": 0, "duplicated": 0,
+            "delayed": 0, "passed": 0,
+        }
+
+    # -- builders --------------------------------------------------------
+
+    def link(
+        self,
+        peer: PeerSpec = "*",
+        direction: str = "both",
+        *,
+        drop: float = 0.0,
+        dup: float = 0.0,
+        reorder: float = 0.0,
+        reorder_delay: float = 0.05,
+        delay: float = 0.0,
+        delay_jitter: float = 0.0,
+    ) -> "FaultPlan":
+        """Add a fault distribution for one link/direction. A datagram
+        matched by this rule draws drop, then dup, then per-copy
+        reorder; every surviving copy is additionally held back
+        ``delay + U[0, delay_jitter)`` seconds."""
+        if direction not in DIRECTIONS:
+            raise ValueError(f"direction must be one of {DIRECTIONS}")
+        self._rules.append(LinkRule(
+            _norm_peer(peer), direction, drop, dup, reorder,
+            reorder_delay, delay, delay_jitter,
+        ))
+        return self
+
+    def partition(
+        self,
+        peer: PeerSpec = "*",
+        direction: str = "both",
+        *,
+        start: float = 0.0,
+        duration: Optional[float] = None,
+    ) -> "FaultPlan":
+        """Black out one link completely for ``[start, start+duration)``
+        seconds after :meth:`arm`. Partitions trump link rules and the
+        endpoint's global rates — during the window *nothing* crosses
+        the matched link in the matched direction."""
+        if direction not in DIRECTIONS:
+            raise ValueError(f"direction must be one of {DIRECTIONS}")
+        self._partitions.append(
+            Partition(_norm_peer(peer), direction, start, duration)
+        )
+        return self
+
+    # -- lifecycle -------------------------------------------------------
+
+    def arm(self, now: Optional[float] = None) -> "FaultPlan":
+        """Start the clock for time-windowed rules. Idempotent: the
+        first call wins, so one plan shared across endpoints has one
+        time base. ``UdpEndpoint.set_fault_plan`` arms automatically."""
+        if self._t0 is None:
+            self._t0 = time.monotonic() if now is None else now
+        return self
+
+    def heal(self) -> None:
+        """Lift every partition immediately (the netsplit ends)."""
+        for part in self._partitions:
+            part.healed = True
+
+    def elapsed(self, now: Optional[float] = None) -> float:
+        if self._t0 is None:
+            return 0.0
+        return (time.monotonic() if now is None else now) - self._t0
+
+    def partitioned(
+        self, direction: str, addr: Addr, now: Optional[float] = None
+    ) -> bool:
+        """Is this link currently blacked out? (pure query: no draws)"""
+        elapsed = self.elapsed(now)
+        return any(
+            part.active(elapsed) and _dir_matches(part.direction, direction)
+            and _peer_matches(part.peer, addr)
+            for part in self._partitions
+        )
+
+    # -- the endpoint-facing decision ------------------------------------
+
+    def decide(self, direction: str, addr: Addr, now: Optional[float] = None):
+        """Decide the fate of one datagram.
+
+        Returns ``None`` when no rule matches — the endpoint falls
+        through to its global rates. Otherwise a verdict tuple:
+
+        - ``(DROP, "partition")`` — blacked out by an active partition
+        - ``(DROP, "rate")`` — lost to the matched rule's drop draw
+        - ``(DELIVER, delays)`` — deliver ``len(delays)`` copies, each
+          after ``delays[i] >= 0`` seconds (0 = immediately)
+        """
+        self.arm(now)
+        if self.partitioned(direction, addr, now):
+            self.stats["partitioned"] += 1
+            return (DROP, "partition")
+        rule = self._match_rule(direction, addr)
+        if rule is None:
+            return None
+        rng = self._rng
+        if rule.drop > 0 and rng.random() < rule.drop:
+            self.stats["dropped"] += 1
+            return (DROP, "rate")
+        copies = 1
+        if rule.dup > 0 and rng.random() < rule.dup:
+            self.stats["duplicated"] += 1
+            copies = 2
+        delays = []
+        for _ in range(copies):
+            held = rule.delay
+            if rule.delay_jitter > 0:
+                held += rng.random() * rule.delay_jitter
+            if rule.reorder > 0 and rng.random() < rule.reorder:
+                held += rule.reorder_delay
+            if held > 0:
+                self.stats["delayed"] += 1
+            delays.append(held)
+        self.stats["passed"] += 1
+        return (DELIVER, delays)
+
+    def _match_rule(self, direction: str, addr: Addr) -> Optional[LinkRule]:
+        best: Optional[LinkRule] = None
+        best_spec = -1
+        for rule in self._rules:
+            if not _dir_matches(rule.direction, direction):
+                continue
+            if not _peer_matches(rule.peer, addr):
+                continue
+            spec = _peer_specificity(rule.peer)
+            if spec > best_spec:
+                best, best_spec = rule, spec
+        return best
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"FaultPlan(seed={self.seed}, rules={len(self._rules)}, "
+            f"partitions={len(self._partitions)}, stats={self.stats})"
+        )
+
+
+class DiskFaultPlan:
+    """Journal disk faults, consulted inside ``Journal._write_sync``.
+
+    - ``fsync_stall_s`` — every fsync sleeps this long first, modelling
+      a device whose write cache is saturated. Exercises the journal's
+      sticky slow-fsync executor fallback (``INLINE_FSYNC_BUDGET_S``).
+    - ``enospc_once`` — the next write raises ``ENOSPC`` once, then the
+      disk "recovers". Exercises the availability-over-durability path:
+      journaling disables itself loudly, serving continues.
+    - ``torn_tail_once`` — the next write persists only a prefix of the
+      record batch then fails, modelling a power cut mid-write. The
+      *next* ``Journal.open`` must scan-and-truncate the torn tail.
+
+    The sleep is intentionally blocking: it runs exactly where a real
+    slow ``os.fsync`` blocks (inline on the loop until the budget trips,
+    then on the executor), because that blockage *is* the fault being
+    injected.
+    """
+
+    def __init__(
+        self,
+        *,
+        fsync_stall_s: float = 0.0,
+        enospc_once: bool = False,
+        torn_tail_once: bool = False,
+    ):
+        self.fsync_stall_s = fsync_stall_s
+        self._enospc_pending = enospc_once
+        self._torn_pending = torn_tail_once
+        self.stats = {"stalls": 0, "enospc": 0, "torn_writes": 0}
+
+    def on_write(self, fh, blob: bytes) -> None:
+        """Called with the batch blob just before it is written. May
+        raise ``OSError`` (after optionally persisting a torn prefix)."""
+        if self._torn_pending:
+            self._torn_pending = False
+            self.stats["torn_writes"] += 1
+            torn = blob[: max(1, len(blob) // 2)]
+            fh.write(torn)
+            fh.flush()
+            raise OSError(errno.EIO, "chaos: torn-tail write (power cut)")
+        if self._enospc_pending:
+            self._enospc_pending = False
+            self.stats["enospc"] += 1
+            raise OSError(errno.ENOSPC, "chaos: no space left on device")
+
+    def on_fsync(self) -> None:
+        """Called just before ``os.fsync``. Blocks for the stall."""
+        if self.fsync_stall_s > 0:
+            self.stats["stalls"] += 1
+            time.sleep(self.fsync_stall_s)

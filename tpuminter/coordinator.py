@@ -381,6 +381,14 @@ class Coordinator:
                 )
                 for host, port in replicate_to
             ]
+        #: split-brain containment (ISSUE 12): True once any shipping
+        #: lane fenced itself against a promoted standby — this
+        #: coordinator is a zombie of a failed-over epoch and must stop
+        #: answering, or a healed netsplit leaves TWO coordinators
+        #: serving the same jobs (duplicate answers)
+        self.fenced = False
+        for rep in self._replicas:
+            rep.on_fenced = self._fence_self
         #: injected replica-ack router (tpuminter.multiloop): a sharded
         #: coordinator's shipping lanes live on the writer loop, so a
         #: non-writer shard gates its winner acks through this callable
@@ -465,6 +473,10 @@ class Coordinator:
             "results_accepted": 0,
             "chunks_requeued": 0,
             "results_rejected": 0,
+            #: repeat offenders dropped from the fleet (unverifiable
+            #: results or refusal floods) — the byzantine-containment
+            #: evidence loadgen's chaos matrix reads
+            "miners_evicted": 0,
             "chunks_hedged": 0,
             "audits_done": 0,
             "audits_failed": 0,
@@ -748,10 +760,36 @@ class Coordinator:
             if ticker is not None:
                 ticker.cancel()
 
+    def _fence_self(self) -> None:
+        """A shipping lane learned (via the promoted standby's RepHello
+        rejection) that a higher-epoch coordinator owns our jobs now.
+        Before ISSUE 12 only the *lane* stopped; the coordinator kept
+        answering, so a healed netsplit ran two coordinators on one job
+        set — the chaos matrix's netsplit cell caught the duplicate
+        answers. Containment: stop serving entirely. Every peer gets an
+        immediate reset, and every later datagram is rejected, so
+        workers/clients rotate to the promoted standby."""
+        if self.fenced:
+            return
+        self.fenced = True
+        log.warning(
+            "coordinator (epoch %d) FENCED: a promoted standby owns a "
+            "higher epoch — dropping %d connection(s) and refusing all "
+            "traffic on this incarnation",
+            self.boot_epoch, len(self._server.conn_ids),
+        )
+        for conn_id in self._server.conn_ids:
+            self._server.reject_conn(conn_id)
+
     def _handle_event(self, event: Tuple[int, Optional[bytes]]) -> None:
         conn_id, payload = event
         if payload is None:
             self._on_lost(conn_id)
+            return
+        if self.fenced:
+            # zombie of a failed-over epoch: never answer — a reset
+            # sends the peer back to its redial rotation
+            self._server.reject_conn(conn_id)
             return
         try:
             msg = decode_msg(payload)
@@ -1287,6 +1325,7 @@ class Coordinator:
                 "miner %d evicted after %d unverifiable results",
                 conn_id, miner.rejections,
             )
+            self.stats["miners_evicted"] += 1
             self._release_assignment(conn_id, miner)
             self._drop_miner(conn_id)
             self._server.close_conn(conn_id)
@@ -1336,6 +1375,7 @@ class Coordinator:
                 "miner %d evicted after %d consecutive refusals",
                 conn_id, miner.refusals,
             )
+            self.stats["miners_evicted"] += 1
             self._drop_miner(conn_id)
             self._server.close_conn(conn_id)
         self._schedule_dispatch()

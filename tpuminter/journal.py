@@ -592,6 +592,12 @@ class Journal:
         #: coordinator keeps serving, loudly undurable
         self._failed = False
         self.boot_epoch = 0
+        #: optional tpuminter.chaos.DiskFaultPlan — injected disk
+        #: degradations (fsync stalls, one-shot ENOSPC, torn-tail
+        #: writes), consulted inside :meth:`_write_sync`, the single
+        #: disk choke point every append/compact/adopt path funnels
+        #: through
+        self.fault_plan = None
         #: coordinator-provided callable returning the snapshot record
         #: (``RecoveredState.snapshot_obj`` shape); compaction is skipped
         #: while unset
@@ -1002,9 +1008,16 @@ class Journal:
     def _write_sync(self, blob: bytes, need_sync: bool) -> None:
         if self._crashed:
             return
+        if self.fault_plan is not None:
+            # may raise OSError (ENOSPC / torn-tail EIO): the flush
+            # paths' existing disk-death handling takes over — exactly
+            # the code path a real bad disk would land in
+            self.fault_plan.on_write(self._fh, blob)
         self._fh.write(blob)
         self._fh.flush()
         if self._fsync and need_sync:
+            if self.fault_plan is not None:
+                self.fault_plan.on_fsync()
             os.fsync(self._fh.fileno())
             self.stats["syncs"] += 1
         self.size += len(blob)

@@ -64,11 +64,21 @@ class UdpEndpoint(asyncio.DatagramProtocol):
     Fault injection lives ABOVE the I/O mode (it runs in
     ``datagram_received``/``send``), so batched and stdlib modes are
     statistically indistinguishable to the layers up.
+
+    Beyond the global rates, :meth:`set_fault_plan` installs a
+    ``tpuminter.chaos.FaultPlan`` — per-link, per-direction rules with
+    time-windowed partitions. A datagram matched by a plan rule is
+    governed by the plan *instead of* the global rates; unmatched
+    datagrams fall through to the rates, so a plan that names one peer
+    leaves every other link untouched.
     """
 
     def __init__(self, on_datagram: DatagramHandler, seed: Optional[int] = None):
         self._on_datagram = on_datagram
         self._rng = random.Random(seed)
+        #: optional tpuminter.chaos.FaultPlan (per-link faults); checked
+        #: before the global rates in datagram_received()/send()
+        self.fault_plan = None
         self.write_drop_rate = 0.0
         self.read_drop_rate = 0.0
         self.write_dup_rate = 0.0
@@ -99,6 +109,9 @@ class UdpEndpoint(asyncio.DatagramProtocol):
         self.duplicated_in = 0
         self.reordered_out = 0
         self.reordered_in = 0
+        #: datagrams eaten by an active FaultPlan partition window
+        self.partitioned_out = 0
+        self.partitioned_in = 0
         #: batched-read evidence: wakeups vs datagrams drained (a ratio
         #: well under 1 wakeup/datagram is the batching working)
         self.read_wakeups = 0
@@ -171,6 +184,11 @@ class UdpEndpoint(asyncio.DatagramProtocol):
             self.datagram_received(data, addr[:2])
 
     def datagram_received(self, data: bytes, addr: Addr) -> None:
+        if self.fault_plan is not None:
+            verdict = self.fault_plan.decide("in", addr)
+            if verdict is not None:
+                self._apply_plan_verdict(verdict, data, addr, inbound=True)
+                return
         if self.read_drop_rate > 0 and self._rng.random() < self.read_drop_rate:
             self.dropped_in += 1
             return
@@ -189,6 +207,41 @@ class UdpEndpoint(asyncio.DatagramProtocol):
                 )
             else:
                 self._deliver(data, addr)
+
+    def _apply_plan_verdict(
+        self, verdict, data: bytes, addr: Addr, *, inbound: bool
+    ) -> None:
+        """Carry out a FaultPlan decision for one datagram. The plan
+        already drew drop/dup/delay; this just books the counters and
+        schedules the surviving copies."""
+        kind, detail = verdict
+        if kind == "drop":
+            if detail == "partition":
+                if inbound:
+                    self.partitioned_in += 1
+                else:
+                    self.partitioned_out += 1
+            elif inbound:
+                self.dropped_in += 1
+            else:
+                self.dropped_out += 1
+            return
+        delays = detail
+        if len(delays) > 1:
+            if inbound:
+                self.duplicated_in += len(delays) - 1
+            else:
+                self.duplicated_out += len(delays) - 1
+        emit = self._deliver if inbound else self._send_now
+        for held in delays:
+            if held > 0:
+                if inbound:
+                    self.reordered_in += 1
+                else:
+                    self.reordered_out += 1
+                self._loop.call_later(held, emit, data, addr)
+            else:
+                emit(data, addr)
 
     def _deliver(self, data: bytes, addr: Addr) -> None:
         if self._is_closing():
@@ -224,6 +277,11 @@ class UdpEndpoint(asyncio.DatagramProtocol):
         """Send one datagram (subject to the injected write faults)."""
         if self._is_closing():
             return
+        if self.fault_plan is not None:
+            verdict = self.fault_plan.decide("out", addr)
+            if verdict is not None:
+                self._apply_plan_verdict(verdict, data, addr, inbound=False)
+                return
         if self.write_drop_rate > 0 and self._rng.random() < self.write_drop_rate:
             self.dropped_out += 1
             return
@@ -254,6 +312,7 @@ class UdpEndpoint(asyncio.DatagramProtocol):
             self.write_drop_rate > 0
             or self.write_dup_rate > 0
             or self.write_reorder_rate > 0
+            or self.fault_plan is not None
         ):
             for data in datagrams:
                 self.send(data, addr)
@@ -274,6 +333,7 @@ class UdpEndpoint(asyncio.DatagramProtocol):
             self.write_drop_rate > 0
             or self.write_dup_rate > 0
             or self.write_reorder_rate > 0
+            or self.fault_plan is not None
         ):
             for addr, datagrams in pairs:
                 for data in datagrams:
@@ -354,6 +414,14 @@ class UdpEndpoint(asyncio.DatagramProtocol):
             self.write_dup_rate = self.read_dup_rate = dup
         if reorder is not None:
             self.write_reorder_rate = self.read_reorder_rate = reorder
+
+    def set_fault_plan(self, plan) -> None:
+        """Install (or clear, with ``None``) a per-link
+        ``tpuminter.chaos.FaultPlan``. Arms the plan's clock so its
+        time-windowed partitions count from installation."""
+        self.fault_plan = plan
+        if plan is not None:
+            plan.arm()
 
     def close(self) -> None:
         if self._sock is not None:

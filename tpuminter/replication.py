@@ -201,6 +201,16 @@ class ReplicationPrimary:
         self._stopped = False
         #: a promoted standby refused our epoch: we are a zombie
         self.fenced = False
+        #: split-brain containment hook (ISSUE 12): called once, on the
+        #: loop, the moment :attr:`fenced` flips — the owning
+        #: coordinator wires this to stop serving (a fenced lane alone
+        #: only stops SHIPPING; the zombie would keep answering miners)
+        self.on_fenced: Optional[Callable[[], None]] = None
+        #: optional tpuminter.chaos.FaultPlan installed on each shipping
+        #: session's endpoint — the seam the chaos matrix uses to cut
+        #: the primary↔standby link specifically (a netsplit) while the
+        #: data plane stays up
+        self.fault_plan = None
         self.last_loss_reason: Optional[str] = None
         #: bytes the standby has confirmed applied (SyncAck high water)
         #: — an offset in the *stream's* space, i.e. generation
@@ -347,6 +357,8 @@ class ReplicationPrimary:
             except LspConnectError:
                 await asyncio.sleep(next(delays))
                 continue
+            if self.fault_plan is not None:
+                client.endpoint.set_fault_plan(self.fault_plan)
             try:
                 self.stats["sessions"] += 1
                 await self._session(client)
@@ -377,6 +389,11 @@ class ReplicationPrimary:
                             self._host, self._port,
                             self._journal.boot_epoch,
                         )
+                        if self.on_fenced is not None:
+                            try:
+                                self.on_fenced()
+                            except Exception:
+                                log.exception("on_fenced hook failed")
                 else:
                     self._resets = 0
             except Exception:
