@@ -803,6 +803,74 @@ def bench_chaos(duration: float = 1.2, seed: int = 0,
     }
 
 
+def bench_admission(seed: int = 0, smoke: bool = False) -> dict:
+    """Admission-control + bounded-state figures (ISSUE 13), CPU-only
+    like the chaos section. Two drills, both self-asserting:
+
+    - the zipf pair: the SAME small-tenant open-loop population
+      measured without and then with a whale at 10x demand, quotas
+      armed — ``admission_small_p99_baseline_ms`` vs
+      ``admission_small_p99_whale_ms`` (and their ratio) is the
+      headline isolation figure; ``admission_refused`` /
+      ``admission_retry_after_honored`` show the backpressure loop
+      actually closing.
+    - the churn wash: thousands of short-lived clients (ghosts
+      included) against a fully capped coordinator with a kill -9 in
+      the middle — the ``admission_churn_*`` high-waters are the
+      plateau evidence, ``admission_churn_final_jobs`` /
+      ``_final_sessions`` the zero-residue evidence.
+
+    ``admission_violations`` sums both scenarios' check verdicts;
+    0 = every admission/bounded-state assertion held.
+    """
+    import asyncio
+
+    loadgen = _import_loadgen()
+
+    zipf = asyncio.run(loadgen.run_zipf(
+        4 if smoke else 8,
+        duration=1.0 if smoke else 1.5,
+        rate=10.0 if smoke else 12.0, seed=seed,
+    ))
+    churn = asyncio.run(loadgen.run_churn(
+        300 if smoke else 2000,
+        concurrency=48 if smoke else 160, seed=seed,
+    ))
+    base = zipf.get("baseline", {})
+    whale = zipf.get("whale", {})
+    p_base = base.get("small_p99_ms") or 0.0
+    p_whale = whale.get("small_p99_ms") or 0.0
+    return {
+        "admission_violations": (
+            len(loadgen.zipf_check(zipf))
+            + len(loadgen.churn_check(churn))
+        ),
+        "admission_small_p99_baseline_ms": base.get("small_p99_ms"),
+        "admission_small_p99_whale_ms": whale.get("small_p99_ms"),
+        "admission_small_p99_ratio": (
+            round(p_whale / p_base, 3) if p_base else None
+        ),
+        "admission_whale_p99_ms": whale.get("whale_p99_ms"),
+        "admission_refused": whale.get("refused_admission"),
+        "admission_retry_after_honored": whale.get(
+            "retry_after_honored"
+        ),
+        "admission_churn_clients": churn.get("clients"),
+        "admission_churn_replay_ms": churn.get("replay_ms"),
+        "admission_churn_jobs_high_water": churn.get("jobs_high_water"),
+        "admission_churn_winners_high_water": churn.get(
+            "winners_high_water"
+        ),
+        "admission_churn_sessions_high_water": churn.get(
+            "sessions_high_water"
+        ),
+        "admission_churn_unbound_reaped": churn.get("unbound_reaped"),
+        "admission_churn_winners_evicted": churn.get("winners_evicted"),
+        "admission_churn_final_jobs": churn.get("final_jobs"),
+        "admission_churn_final_sessions": churn.get("final_sessions"),
+    }
+
+
 def bench_multiloop(fleet: int = 64, duration: float = 4.0,
                     pairs: int = 3) -> dict:
     """Multi-loop sharding + batched socket I/O cost accounting
@@ -1071,6 +1139,7 @@ def main() -> None:
         extra.update(bench_recovery(duration=1.5, pairs=1))
         extra.update(bench_replication(duration=1.5, pairs=1))
         extra.update(bench_chaos(duration=1.0, smoke=True))
+        extra.update(bench_admission(smoke=True))
         extra.update(bench_rolled(pairs=1, nb_points=(8,)))
         extra.update(bench_native(seconds=0.5))
     elif jax.default_backend() == "cpu":
@@ -1088,6 +1157,7 @@ def main() -> None:
         extra.update(bench_recovery())
         extra.update(bench_replication())
         extra.update(bench_chaos())
+        extra.update(bench_admission())
         extra.update(bench_rolled())
         extra.update(bench_native())
     else:
@@ -1120,6 +1190,7 @@ def main() -> None:
         extra.update(bench_recovery())
         extra.update(bench_replication())
         extra.update(bench_chaos())
+        extra.update(bench_admission())
         extra.update(bench_rolled())
         extra.update(bench_native())
     ghs = rate / 1e9

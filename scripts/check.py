@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
 """Run the project's static-analysis suite over the tree.
 
-    python scripts/check.py                 # all four checkers + ruff
+    python scripts/check.py                 # all five checkers + ruff
     python scripts/check.py --json          # machine-readable findings
     python scripts/check.py --checker loop-blocker tpuminter/journal.py
 
@@ -62,7 +62,7 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--checker", action="append", choices=CHECKERS, default=None,
-        help="run only this checker (repeatable; default: all four)",
+        help="run only this checker (repeatable; default: all five)",
     )
     parser.add_argument(
         "--allowlist", default=None,

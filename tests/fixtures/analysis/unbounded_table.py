@@ -1,0 +1,37 @@
+"""Fixture: the ISSUE 13 bug class — a long-lived (affinity-stamped)
+control-plane object accreting per-client state with no eviction seam.
+
+`Registry._ledger` and `Registry._backlog` grow with client churn and
+nothing ever removes entries: both must be flagged.  `_winners` has a
+pop seam, `_recent` is bounded by construction, and `Scratch` is not
+stamped (request-scoped): none of those may fire.
+"""
+
+from collections import OrderedDict, deque
+
+from tpuminter.analysis import affinity
+
+
+class Registry:
+    def __init__(self):
+        affinity.stamp(self)
+        self._ledger = {}                 # BAD: keyed by ckey, never shrunk
+        self._backlog = deque()           # BAD: unbounded queue
+        self._winners = OrderedDict()     # ok: popped in retire()
+        self._recent = deque(maxlen=64)   # ok: bounded by construction
+        self._seeded = dict(alpha=1)      # ok: not an empty construction
+
+    def book(self, ckey, value):
+        self._ledger[ckey] = value
+        self._backlog.append((ckey, value))
+        self._recent.append(ckey)
+
+    def retire(self, key):
+        self._winners.pop(key, None)
+
+
+class Scratch:
+    """Request-scoped: lives for one call, no stamp, no lifetime risk."""
+
+    def __init__(self):
+        self.items = {}

@@ -34,6 +34,7 @@ def _fixture_findings(name, checkers):
     src = parse_module(REPO_ROOT, os.path.join(FIXTURES, name))
     findings = []
     from tpuminter.analysis import (
+        bounded_state,
         codec_conformance,
         loop_blocker,
         retrace,
@@ -44,6 +45,7 @@ def _fixture_findings(name, checkers):
         "retrace-hazard": retrace,
         "thread-seam": thread_seam,
         "codec-conformance": codec_conformance,
+        "bounded-state": bounded_state,
     }
     for checker in checkers:
         findings.extend(registry[checker].check_module(src))
@@ -151,6 +153,19 @@ def test_codec_conformance_catches_bad_table():
         f.qualname == "encode_ping" and f.symbol == "_PING"
         for f in findings
     )
+
+
+def test_bounded_state_catches_unbounded_table():
+    findings = _fixture_findings("unbounded_table.py", ["bounded-state"])
+    symbols = {f.symbol for f in findings}
+    assert "self._ledger" in symbols   # dict, no eviction seam
+    assert "self._backlog" in symbols  # deque, no maxlen, never drained
+    # attributes WITH a seam or bound, and unstamped classes, stay quiet
+    assert "self._winners" not in symbols   # popped in retire()
+    assert "self._recent" not in symbols    # deque(maxlen=...)
+    assert "self._seeded" not in symbols    # non-empty construction
+    assert not any(f.qualname.startswith("Scratch") for f in findings)
+    assert all(f.qualname == "Registry.__init__" for f in findings)
 
 
 # ---------------------------------------------------------------------------

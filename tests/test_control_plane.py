@@ -362,3 +362,164 @@ def test_loadgen_chaos_smoke_gate(capsys):
     assert bz["miners_evicted"] > 0
     assert bz["results_rejected"] > 0
     assert bz["chunks_requeued"] > 0
+
+
+# ---------------------------------------------------------------------------
+# admission & bounded state (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+def _trim_oracle(table, cap, ttl, now):
+    """Independent mirror of ``Coordinator._trim_winners`` semantics:
+    the set of keys the bounds allow evicting. Only durable entries
+    with no parked waiters qualify; size excess goes first (insertion
+    order), then anything older than ``ttl``."""
+    if len(table) <= cap and not ttl:
+        return set()
+    evictable = [
+        k for k, w in table.items() if w.durable and not w.waiters
+    ]
+    excess = max(0, len(table) - cap)
+    evicted = set(evictable[:excess])
+    if ttl:
+        cutoff = now - ttl
+        for k in evictable[excess:]:
+            if table[k].ts <= cutoff:
+                evicted.add(k)
+    return evicted
+
+
+def test_winner_trim_never_evicts_unacked_seeded():
+    """Deterministic mirror of the dedup-table bound (ISSUE 13): over
+    400 seeded random winner tables, ``_trim_winners`` evicts exactly
+    the oracle's set — and NEVER an un-acknowledged entry (not yet
+    durable, or with re-submitters parked on the durability callback),
+    whatever the size/age pressure. Evicting one would answer a client
+    twice; the cap may be exceeded, exactly-once may not."""
+    import random
+    import time as _time
+    from collections import OrderedDict
+
+    from tpuminter.coordinator import _Winner
+    from tpuminter.protocol import PowMode as _PM
+
+    dummy = Result(1, _PM.MIN, nonce=1, hash_value=1, found=True,
+                   searched=1, chunk_id=0)
+    rng = random.Random(0x15E13)
+    for _ in range(400):
+        now = _time.time()
+        ttl = rng.choice([0.0, 100.0])
+        table = OrderedDict()
+        for i in range(rng.randrange(0, 24)):
+            table[("ck%d" % i, i)] = _Winner(
+                dummy,
+                durable=rng.random() < 0.6,
+                waiters=[7] if rng.random() < 0.3 else [],
+                # far from the cutoff on both sides: jitter-proof
+                ts=now - (1000.0 if rng.random() < 0.5 else 0.0),
+            )
+        cap = rng.randrange(0, 16)
+        unacked = {
+            k for k, w in table.items() if not w.durable or w.waiters
+        }
+        expected = _trim_oracle(table, cap, ttl, now)
+
+        coord = Coordinator.__new__(Coordinator)
+        coord._winners = OrderedDict(table)
+        coord._winners_cap = cap
+        coord._winners_ttl = ttl
+        coord.stats = {"winners_evicted": 0}
+        coord._trim_winners()
+
+        survivors = set(coord._winners)
+        assert unacked <= survivors, "un-acked winner evicted"
+        assert set(table) - survivors == expected
+        assert coord.stats["winners_evicted"] == len(expected)
+
+
+def test_session_loss_reclaims_per_session_state():
+    """Churn's per-session invariant, end-to-end: when a client session
+    dies without a goodbye, everything keyed by it is reclaimed — the
+    anonymous client's ``@conn:`` quota bucket and jobs go at loss
+    detection; the durable client's job rides UNBOUND until
+    ``unbound_ttl`` and is then reaped (its identity-keyed bucket
+    deliberately persists: a redial must not refill quota)."""
+
+    async def scenario():
+        cluster = await Cluster.create(
+            n_miners=0, chunk_size=512, quota_rate=50.0,
+            unbound_ttl=0.3, stats_interval=0.2,
+        )
+        coord = cluster.coord
+        try:
+            anon = await LspClient.connect("127.0.0.1", coord.port, FAST)
+            anon.write(encode_msg(Request(
+                job_id=1, mode=PowMode.MIN, lower=0, upper=1 << 22,
+                data=b"anon-session",
+            )))
+            durable = await LspClient.connect(
+                "127.0.0.1", coord.port, FAST
+            )
+            durable.write(encode_msg(Request(
+                job_id=1, mode=PowMode.MIN, lower=0, upper=1 << 22,
+                data=b"durable-session", client_key="reclaim:1",
+            )))
+            for _ in range(100):  # both jobs admitted and tracked
+                if len(coord._jobs) == 2 and len(coord._clients) == 2:
+                    break
+                await asyncio.sleep(0.05)
+            assert len(coord._jobs) == 2
+            assert any(k.startswith("@conn:") for k in coord._buckets)
+            assert "reclaim:1" in coord._buckets
+
+            # vanish without goodbye: the server only learns via the
+            # epoch-liveness horizon, like a kill -9'd client process
+            await anon.close(drain_timeout=0.05)
+            await durable.close(drain_timeout=0.05)
+            for _ in range(200):  # horizon (1.25 s) + unbound_ttl + tick
+                if not coord._jobs and not coord._clients:
+                    break
+                await asyncio.sleep(0.05)
+            assert not coord._clients, "per-session table not reclaimed"
+            assert not coord._jobs, "UNBOUND residue not reaped"
+            assert coord.stats["unbound_reaped"] >= 1
+            assert not any(
+                k.startswith("@conn:") for k in coord._buckets
+            ), "anonymous quota bucket outlived its session"
+            # the durable identity's bucket is NOT per-session state
+            assert "reclaim:1" in coord._buckets
+        finally:
+            await cluster.close()
+
+    run(scenario(), timeout=60.0)
+
+
+def test_loadgen_churn_smoke_gate(capsys):
+    """The tier-1 churn gate (ISSUE 13): ``--scenario churn --smoke``
+    washes hundreds of short-lived clients (40% abandoning mid-job)
+    through a capped coordinator, kill -9s it mid-churn, and gates on
+    ``churn_check`` behind rc — every table plateaus at its cap-derived
+    bound, ghosts leave zero residue, replay lands within the same
+    bounds, and the exactly-once ledger holds — reproducible from
+    ``--seed``."""
+    import json as _json
+
+    rc = loadgen.main([
+        "--scenario", "churn", "--smoke", "--seed", "3", "--json",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, f"churn smoke gate failed: {out}"
+    metrics = _json.loads(out.splitlines()[0])
+    assert metrics["seed"] == 3
+    # re-asserted past churn_check so a loosened check cannot silently
+    # drop the criteria (same belt-and-braces as the chaos gate)
+    assert metrics["answered"] > 0
+    assert metrics["answers_duplicated"] == 0
+    assert metrics["unanswered"] == 0
+    assert metrics["abandoned"] > 0
+    assert metrics["unbound_reaped"] > 0
+    assert metrics["jobs_high_water"] <= metrics["max_jobs"]
+    assert metrics["sessions_high_water"] <= metrics["session_bound"]
+    assert metrics["final_jobs"] == 0
+    assert metrics["final_sessions"] == 0
+    assert metrics["recovered_jobs"] <= metrics["max_jobs"]
+    assert metrics["recovered_winners"] <= metrics["winners_cap"]

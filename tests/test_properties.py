@@ -374,7 +374,8 @@ messages = st.one_of(
         upper=st.integers(0, 2**64 - 1),
     ),
     st.builds(
-        Refuse, job_id=st.integers(0, 2**31), chunk_id=st.integers(0, 2**31)
+        Refuse, job_id=st.integers(0, 2**31), chunk_id=st.integers(0, 2**31),
+        retry_after_ms=st.integers(0, 2**32 - 1),
     ),
     st.builds(Cancel, job_id=st.integers(0, 2**31)),
 )
@@ -427,6 +428,7 @@ hot_messages = st.one_of(
         Refuse,
         job_id=st.integers(0, 2**64 - 1),
         chunk_id=st.integers(0, 2**64 - 1),
+        retry_after_ms=st.integers(0, 2**32 - 1),
     ),
     st.builds(Cancel, job_id=st.integers(0, 2**64 - 1)),
 )
@@ -758,3 +760,66 @@ def test_backoff_saturates_at_cap_and_is_seed_deterministic(
     assert [next(gen_a) for _ in range(30)] == [
         next(gen_b) for _ in range(30)
     ]
+
+
+# ---------------------------------------------------------------------------
+# winner/dedup-table bound (ISSUE 13): the eviction policy may shrink
+# the table, never break exactly-once (deterministic seeded mirror
+# lives in tests/test_control_plane.py — this image lacks hypothesis)
+# ---------------------------------------------------------------------------
+
+import time as _time  # noqa: E402
+from collections import OrderedDict  # noqa: E402
+
+from tpuminter.coordinator import Coordinator, _Winner  # noqa: E402
+
+from tests.test_control_plane import _trim_oracle  # noqa: E402
+
+_dummy_result = Result(
+    1, PowMode.MIN, nonce=1, hash_value=1, found=True, searched=1,
+    chunk_id=0,
+)
+
+_winner_entries = st.lists(
+    st.tuples(
+        st.booleans(),                 # durable (finish record fsynced)
+        st.booleans(),                 # has parked re-submitters
+        st.booleans(),                 # older than any ttl
+    ),
+    max_size=24,
+)
+
+
+@settings(max_examples=200)
+@given(
+    _winner_entries,
+    st.integers(0, 16),                # winners_cap
+    st.sampled_from([0.0, 100.0]),     # winners_ttl (0 = size-only)
+)
+def test_winner_trim_never_evicts_unacked(entries, cap, ttl):
+    """Whatever the size/age pressure, ``_trim_winners`` removes
+    exactly the oracle's evictable set and never an un-acknowledged
+    entry (not durable yet, or with waiters parked on the durability
+    callback) — the bound may be exceeded, exactly-once may not."""
+    now = _time.time()
+    table = OrderedDict()
+    for i, (durable, waiter, stale) in enumerate(entries):
+        table[("ck%d" % i, i)] = _Winner(
+            _dummy_result, durable=durable,
+            waiters=[7] if waiter else [],
+            ts=now - (1000.0 if stale else 0.0),
+        )
+    unacked = {k for k, w in table.items() if not w.durable or w.waiters}
+    expected = _trim_oracle(table, cap, ttl, now)
+
+    coord = Coordinator.__new__(Coordinator)
+    coord._winners = OrderedDict(table)
+    coord._winners_cap = cap
+    coord._winners_ttl = ttl
+    coord.stats = {"winners_evicted": 0}
+    coord._trim_winners()
+
+    survivors = set(coord._winners)
+    assert unacked <= survivors
+    assert set(table) - survivors == expected
+    assert coord.stats["winners_evicted"] == len(expected)

@@ -54,12 +54,39 @@ __all__ = [
 ]
 
 _OWNER = "_affinity_owner_ident"
+_GEN = "_affinity_owner_gen"
 
 _enabled = False
 _strict = False
+#: bumped on every enable(): a stamp from an earlier enabled window is
+#: stale — instrumented classes outlive disable() (the subclass swap is
+#: never undone), so without this a test-ordering accident would let
+#: objects stamped by one test record violations during another test's
+#: window (the pre-ISSUE-13 full-suite flake in test_analysis)
+_generation = 0
 _lock = threading.Lock()
 _violations: List[dict] = []
 _instrumented: Dict[type, type] = {}
+
+#: Thread identity that is unique for the PROCESS lifetime, unlike
+#: ``threading.get_ident()`` — the pthread handle is recycled the
+#: moment a joined thread's stack is reused, so a new loop thread can
+#: alias a dead owner and a genuine cross-loop write compares equal
+#: (the residual test_analysis flake: owner loop exits, intruder loop
+#: starts on the recycled ident, violation silently missed).
+_thread_tokens = threading.local()
+_next_token = 0
+
+
+def _thread_token() -> int:
+    global _next_token
+    token = getattr(_thread_tokens, "token", None)
+    if token is None:
+        with _lock:
+            _next_token += 1
+            token = _next_token
+        _thread_tokens.token = token
+    return token
 
 
 class LoopAffinityError(AssertionError):
@@ -71,9 +98,10 @@ def enabled() -> bool:
 
 
 def enable(strict: bool = False) -> None:
-    global _enabled, _strict
+    global _enabled, _strict, _generation
     _enabled = True
     _strict = strict
+    _generation += 1
 
 
 def disable() -> None:
@@ -117,8 +145,13 @@ def _instrument(cls: type) -> type:
 
     def __setattr__(self, name, value):  # noqa: N807
         owner = self.__dict__.get(_OWNER)
-        if owner is not None and not name.startswith("_affinity_"):
-            writer = threading.get_ident()
+        if (
+            _enabled
+            and owner is not None
+            and self.__dict__.get(_GEN) == _generation
+            and not name.startswith("_affinity_")
+        ):
+            writer = _thread_token()
             if writer != owner and _get_running_loop() is not None:
                 _record(self, name, owner, writer)
         cls.__setattr__(self, name, value)
@@ -143,7 +176,8 @@ def stamp(obj: object) -> object:
             obj.__class__ = _instrument(cls)
         except TypeError:  # __slots__/extension layouts: skip quietly
             return obj
-    object.__setattr__(obj, _OWNER, threading.get_ident())
+    object.__setattr__(obj, _OWNER, _thread_token())
+    object.__setattr__(obj, _GEN, _generation)
     return obj
 
 

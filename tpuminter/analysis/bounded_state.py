@@ -1,0 +1,189 @@
+"""bounded-state: unbounded module-lifetime containers on loop-owned
+control-plane objects (ISSUE 13's bug class).
+
+The coordinator lives for the lifetime of the process while clients,
+jobs, and winners churn through it at thousands per minute. Every
+container it keys by something churn-scaled — ckey, conn_id, job_id,
+share hash — is a slow memory leak unless something, somewhere, takes
+entries OUT. PR 13's admission work bounded every such table on
+``Coordinator``; this checker keeps the invariant: the NEXT dict added
+to a long-lived class must ship with its eviction seam or carry an
+allowlist entry explaining why it is bounded by construction.
+
+The model, derived per module:
+
+- *long-lived classes*: classes whose ``__init__`` calls
+  ``affinity.stamp(self)`` — the affinity stamp marks exactly the
+  loop-owned, process-lifetime control-plane objects (Coordinator,
+  Journal, replication endpoints), so it doubles as the lifetime
+  oracle here;
+- *growable attributes*: ``self.X = {}`` / ``dict()`` / ``set()`` /
+  ``OrderedDict()`` / ``defaultdict(...)`` / ``deque()`` assignments in
+  ``__init__``.  Only EMPTY constructions count — a container seeded
+  from an argument is somebody else's sizing decision — and
+  ``deque(maxlen=...)`` is bounded by construction;
+- *cap seams*: any method of the same class that removes entries —
+  ``self.X.pop(...)`` / ``.popitem()`` / ``.popleft()`` /
+  ``.discard()`` / ``.remove()`` / ``.clear()`` / ``del self.X[...]``.
+
+A growable attribute with no cap seam anywhere in its class is
+flagged: nothing in the object's own lifecycle can ever shrink it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from tpuminter.analysis.core import Finding, ModuleSource, dotted
+
+CHECKER = "bounded-state"
+
+#: Empty constructions of these callables grow without bound unless an
+#: eviction seam exists. deque is handled separately (maxlen= bounds it).
+GROWABLE_CTORS = {"dict", "set", "OrderedDict", "defaultdict", "Counter"}
+
+#: Method calls on an attribute that shrink it.
+EVICTING_METHODS = {
+    "pop", "popitem", "popleft", "popright", "discard", "remove", "clear",
+}
+
+
+def _is_empty_growable(value: ast.expr) -> bool:
+    """True for ``{}`` / ``set()`` / ``dict()`` / ``OrderedDict()`` /
+    ``defaultdict(list)`` / ``deque()``-without-maxlen expressions."""
+    if isinstance(value, ast.Dict):
+        return not value.keys
+    if isinstance(value, ast.Set):
+        return False  # literal sets are never empty in Python syntax
+    if not isinstance(value, ast.Call):
+        return False
+    ctor = dotted(value.func)
+    if ctor is None:
+        return False
+    base = ctor.rsplit(".", 1)[-1]
+    if base == "deque":
+        if any(kw.arg == "maxlen" for kw in value.keywords):
+            return False  # bounded by construction
+        return not value.args  # deque(seed) is someone else's sizing
+    if base not in GROWABLE_CTORS:
+        return False
+    if base == "defaultdict":
+        # defaultdict(list) is still empty; only the factory arg is given
+        return len(value.args) <= 1 and not value.keywords
+    return not value.args and not value.keywords
+
+
+def _calls_stamp(init: ast.FunctionDef) -> bool:
+    for node in ast.walk(init):
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name is not None and name.rsplit(".", 1)[-1] == "stamp":
+                if node.args and dotted(node.args[0]) == "self":
+                    return True
+    return False
+
+
+def _self_attr(node: ast.expr) -> str:
+    """'attr' when node is ``self.attr``, else ''."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return ""
+
+
+def _evicted_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Attributes the class shrinks somewhere in its own body."""
+    seams: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call):
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in EVICTING_METHODS
+            ):
+                attr = _self_attr(func.value)
+                if attr:
+                    seams.add(attr)
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                # del self.X[key]  (and del self.X, the nuclear seam)
+                if isinstance(tgt, ast.Subscript):
+                    attr = _self_attr(tgt.value)
+                else:
+                    attr = _self_attr(tgt)
+                if attr:
+                    seams.add(attr)
+        elif isinstance(node, ast.Assign):
+            # wholesale replacement (self.X = {} outside __init__ is a
+            # reset seam, e.g. recovery rebuild) — handled by the caller
+            # only looking at __init__ assignments, so nothing needed.
+            pass
+    return seams
+
+
+def check_module(src: ModuleSource) -> List[Finding]:
+    stamped: List[ast.ClassDef] = []
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if (
+                    isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and item.name == "__init__"
+                    and _calls_stamp(item)
+                ):
+                    stamped.append(node)
+                    break
+    if not stamped:
+        return []  # module has no long-lived loop-owned classes
+
+    findings: List[Finding] = []
+    for cls in stamped:
+        init = next(
+            item for item in cls.body
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and item.name == "__init__"
+        )
+        growable: Dict[str, Tuple[int, str]] = {}
+        for node in ast.walk(init):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            if not _is_empty_growable(value):
+                continue
+            for tgt in targets:
+                attr = _self_attr(tgt)
+                if attr:
+                    kind = (
+                        dotted(value.func).rsplit(".", 1)[-1]
+                        if isinstance(value, ast.Call) else "dict"
+                    )
+                    growable[attr] = (node.lineno, kind)
+        if not growable:
+            continue
+        seams = _evicted_attrs(cls)
+        for attr in sorted(growable):
+            if attr in seams:
+                continue
+            lineno, kind = growable[attr]
+            findings.append(Finding(
+                CHECKER, src.path, lineno, f"{cls.name}.__init__",
+                f"self.{attr}",
+                f"unbounded {kind} on long-lived class {cls.name!r}: "
+                f"no method of the class ever removes entries "
+                f"(pop/popitem/popleft/discard/remove/clear/del), so "
+                f"under client or job churn this table only grows — "
+                f"add a cap + eviction seam (see Coordinator._trim_"
+                f"winners / _reap_unbound), bound it by construction "
+                f"(deque(maxlen=...)), or allowlist it with the reason "
+                f"its key space is bounded",
+            ))
+    return findings

@@ -37,6 +37,7 @@ CHECKERS = (
     "retrace-hazard",
     "thread-seam",
     "codec-conformance",
+    "bounded-state",
 )
 
 
@@ -238,6 +239,7 @@ def run_project(
     they define it, the whole parsed set at once (``check_project`` —
     the codec checker's cross-module tag-namespace invariant)."""
     from tpuminter.analysis import (
+        bounded_state,
         codec_conformance,
         loop_blocker,
         retrace,
@@ -249,6 +251,7 @@ def run_project(
         "retrace-hazard": retrace,
         "thread-seam": thread_seam,
         "codec-conformance": codec_conformance,
+        "bounded-state": bounded_state,
     }
     selected = checkers or CHECKERS
     modules = [parse_module(root, p) for p in iter_python_files(root, targets)]

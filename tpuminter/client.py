@@ -25,7 +25,14 @@ from tpuminter.lsp import (
     Params,
 )
 from tpuminter.lsp.params import FAST, jittered_backoff
-from tpuminter.protocol import PowMode, Request, Result, decode_msg, encode_msg
+from tpuminter.protocol import (
+    PowMode,
+    Refuse,
+    Request,
+    Result,
+    decode_msg,
+    encode_msg,
+)
 
 __all__ = ["submit", "main"]
 
@@ -93,6 +100,28 @@ async def submit(
                 msg = decode_msg(await client.read())
                 if isinstance(msg, Result) and msg.job_id == request.job_id:
                     return msg
+                if (
+                    isinstance(msg, Refuse)
+                    and msg.retry_after_ms > 0
+                    and msg.job_id == request.job_id
+                ):
+                    # admission backpressure (ISSUE 13): the coordinator
+                    # said "not now, come back in ~retry_after_ms". Honor
+                    # it on the SAME connection with jitter (0.5–1.5× so
+                    # a refused thundering herd decorrelates) and
+                    # re-submit; the durable client_key + original job_id
+                    # make the re-submission exactly-once safe.
+                    base = msg.retry_after_ms / 1000.0
+                    wait = base * ((rng.random() if rng
+                                    else random.random()) + 0.5)
+                    log.info(
+                        "client: admission refused for job %d; retrying "
+                        "in %.3fs (suggested %d ms)",
+                        request.job_id, wait, msg.retry_after_ms,
+                    )
+                    await asyncio.sleep(wait)
+                    client.write(encode_msg(request))
+                    continue
                 log.warning(
                     "client: ignoring unexpected %s", type(msg).__name__
                 )

@@ -170,6 +170,23 @@ MAX_AUDIT_RETRIES = 2
 SCRYPT_CHUNK_DIVISOR = 8192
 SCRYPT_MIN_CHUNK = 512
 
+#: Hard cap on the per-client token-bucket table (admission control,
+#: ISSUE 13). Keyed by durable ckey, so 10k+ churned identities would
+#: otherwise grow it forever; LRU-shed. A shed bucket that comes back
+#: refills to burst — under a churn storm that forgives the oldest
+#: idle identities a little quota, which is the cheap side of the
+#: trade (the alternative is unbounded memory).
+QUOTA_BUCKETS_CAP = 4096
+
+#: Base retry-after suggestion (ms) for an admission Refuse when the
+#: refusal is capacity-driven rather than quota-driven (a quota refusal
+#: computes the exact token-accrual time instead).
+DEFAULT_RETRY_AFTER_MS = 250
+
+#: retry_after_ms is a u32 on the wire; a pathological quota config
+#: (rate → 0) must not suggest a year
+MAX_RETRY_AFTER_MS = 60_000
+
 
 @dataclass
 class _MinerState:
@@ -246,11 +263,18 @@ class _Winner:
     then (the answer could still be rolled back by a crash, and a
     TARGET-mode re-mine can land on a different nonce); re-submitters
     arriving in that window park in ``waiters`` and are delivered by
-    the same durability callback that answers the original client."""
+    the same durability callback that answers the original client.
+
+    ``ts`` is WALL time (it must survive a restart via the journal's
+    finish record) and feeds the age bound: an entry older than
+    ``winners_ttl`` is evictable — but only once durable with no
+    parked waiters; an un-acknowledged winner is NEVER evicted
+    (``Coordinator._trim_winners``)."""
 
     result: Result
     durable: bool
     waiters: List[int] = field(default_factory=list)
+    ts: float = field(default_factory=time.time)
 
 
 @dataclass
@@ -284,6 +308,9 @@ class _Job:
     done: bool = False
     started: float = field(default_factory=time.monotonic)
     hashes_done: int = 0
+    #: monotonic instant the owning durable client was last lost (0 =
+    #: currently bound); the UNBOUND-residue reaper's clock
+    unbound_since: float = 0.0
 
     def fold(self, hash_value: int, nonce: int) -> None:
         if self.best is None or (hash_value, nonce) < self.best:
@@ -335,9 +362,52 @@ class Coordinator:
         job_id_start: int = 1,
         job_id_stride: int = 1,
         replica_gate=None,
+        quota_rate: float = 0.0,
+        quota_burst: int = 8,
+        quota_tiers: Optional[Dict[str, float]] = None,
+        max_jobs: int = 0,
+        retry_after_ms: int = DEFAULT_RETRY_AFTER_MS,
+        winners_cap: int = WINNERS_CAP,
+        winners_ttl: float = 0.0,
+        unbound_ttl: float = 0.0,
     ):
         self._server = server
         self._chunk_size = chunk_size
+        # -- admission & fairness (ISSUE 13) --------------------------
+        if quota_rate < 0 or quota_burst < 1:
+            raise ValueError("quota_rate must be >= 0, quota_burst >= 1")
+        if max_jobs < 0 or winners_cap < 1:
+            raise ValueError("max_jobs must be >= 0, winners_cap >= 1")
+        #: job-submission tokens per second per client identity; 0
+        #: disables quota metering entirely (the default: admission
+        #: control is an operator opt-in, like hedging and audits)
+        self._quota_rate = quota_rate
+        self._quota_burst = quota_burst
+        #: priority tiers: ckey prefix before ':' → rate/burst
+        #: multiplier ("gold:alice" at {"gold": 4.0} gets 4× quota)
+        self._quota_tiers = dict(quota_tiers or {})
+        #: hard cap on live jobs; 0 = unbounded (the pre-ISSUE-13
+        #: behavior). Over-cap submissions LRU-shed a zero-progress
+        #: pending job back to Refuse, else refuse the newcomer.
+        self._max_jobs = max_jobs
+        self._retry_after_ms = max(1, min(retry_after_ms, MAX_RETRY_AFTER_MS))
+        #: dedup-table bounds: size (entries) and age (seconds; 0 = no
+        #: age bound). An un-acknowledged winner is never evicted.
+        self._winners_cap = winners_cap
+        self._winners_ttl = winners_ttl
+        #: seconds an UNBOUND durable job (its client died) survives
+        #: before being reaped; 0 = keep forever (pre-ISSUE-13). The
+        #: churn-residue bound: 10k dead clients must leave no jobs.
+        self._unbound_ttl = unbound_ttl
+        #: per-client token buckets, ckey → (tokens, last_refill);
+        #: LRU-bounded at QUOTA_BUCKETS_CAP
+        #: ckey -> (tokens, last_refill_ts, consecutive_refusals)
+        self._buckets: "OrderedDict[str, Tuple[float, float, int]]" = (
+            OrderedDict()
+        )
+        #: (unbound_since, job_id) reap queue, monotone by time — the
+        #: amortized-O(1) UNBOUND sweep; drained by _reap_unbound
+        self._unbound_q: Deque[Tuple[float, int]] = deque()
         if pipeline_depth < 1:
             raise ValueError("pipeline_depth must be >= 1")
         #: outstanding chunks per miner (DEFAULT_PIPELINE_DEPTH); 1
@@ -489,6 +559,25 @@ class Coordinator:
             #: RepHellos rejected by the fencing rule (a zombie primary
             #: of a failed-over epoch knocking on the promoted door)
             "replication_fenced": 0,
+            #: admission control (ISSUE 13): submissions answered with
+            #: Refuse{retry_after_ms} instead of a job
+            "refused_admission": 0,
+            #: zero-progress pending jobs LRU-shed back to Refuse to
+            #: make room under --max-jobs
+            "jobs_shed": 0,
+            #: UNBOUND durable jobs reaped after unbound_ttl (their
+            #: churned clients never came back)
+            "unbound_reaped": 0,
+            #: dedup-table entries evicted by the size/age bounds
+            #: (acknowledged ones only — never an un-acked winner)
+            "winners_evicted": 0,
+            #: table high-waters — the loadgen churn scenario's
+            #: plateau evidence (bounded state under 10k+ churned
+            #: clients means these stop growing)
+            "jobs_high_water": 0,
+            "winners_high_water": 0,
+            "sessions_high_water": 0,
+            "quota_buckets_high_water": 0,
         }
         # TPUMINTER_LOOP_AFFINITY=1: the coordinator is single-loop by
         # contract (one per shard in multiloop); any mutation arriving
@@ -515,6 +604,14 @@ class Coordinator:
         replicate_to: Optional[List[Tuple[str, int]]] = None,
         replica_ack: bool = False,
         io_batch: Optional[bool] = None,
+        quota_rate: float = 0.0,
+        quota_burst: int = 8,
+        quota_tiers: Optional[Dict[str, float]] = None,
+        max_jobs: int = 0,
+        retry_after_ms: int = DEFAULT_RETRY_AFTER_MS,
+        winners_cap: int = WINNERS_CAP,
+        winners_ttl: float = 0.0,
+        unbound_ttl: float = 0.0,
     ) -> "Coordinator":
         """``recover_from`` names a write-ahead journal file
         (``tpuminter.journal``): if it exists its records are replayed —
@@ -529,7 +626,9 @@ class Coordinator:
         recovered: Optional[RecoveredState] = None
         boot_epoch: Optional[int] = None
         if recover_from is not None:
-            journal, recovered = Journal.open(recover_from)
+            journal, recovered = Journal.open(
+                recover_from, winners_cap=winners_cap
+            )
             boot_epoch = recovered.boot_epoch
         server = await LspServer.create(
             port, params or FAST, host=host, boot_epoch=boot_epoch,
@@ -542,6 +641,10 @@ class Coordinator:
             journal_assigns=journal_assigns, pipeline_depth=pipeline_depth,
             binary_codec=binary_codec, journal_tick_flush=journal_tick_flush,
             replicate_to=replicate_to, replica_ack=replica_ack,
+            quota_rate=quota_rate, quota_burst=quota_burst,
+            quota_tiers=quota_tiers, max_jobs=max_jobs,
+            retry_after_ms=retry_after_ms, winners_cap=winners_cap,
+            winners_ttl=winners_ttl, unbound_ttl=unbound_ttl,
         )
         if recovered is not None:
             coord._adopt(recovered)
@@ -568,7 +671,15 @@ class Coordinator:
             phase = self._next_job_id % stride
             nxt = recovered.next_job_id
             self._next_job_id = nxt + (phase - nxt % stride) % stride
+        now_wall = time.time()
         for (ckey, cjid), rec in recovered.winners.items():
+            ts = float(rec.get("ts", now_wall))
+            if self._winners_ttl and now_wall - ts > self._winners_ttl:
+                # aged out while we were down: the age bound applies
+                # across restarts, so replay rebuilds the same bounded
+                # view a live sweep would have left
+                self.stats["winners_evicted"] += 1
+                continue
             # replayed winners are durable by construction: they came
             # off the fsynced record stream
             self._winners[(ckey, cjid)] = _Winner(
@@ -578,7 +689,9 @@ class Coordinator:
                     searched=int(rec["s"]),
                 ),
                 durable=True,
+                ts=ts,
             )
+        self._trim_winners()
         finish_now = []
         for rjob in recovered.jobs.values():
             job = _Job(
@@ -591,6 +704,13 @@ class Coordinator:
             job.best = rjob.best
             job.hashes_done = rjob.hashes_done
             self._jobs[job.job_id] = job
+            if self._unbound_ttl:
+                # a recovered job is UNBOUND until its client
+                # re-submits: enroll it in the residue reaper so a
+                # crash mid-churn replays to the same bounded state
+                # (orphans whose clients never return are still reaped)
+                job.unbound_since = time.monotonic()
+                self._unbound_q.append((job.unbound_since, job.job_id))
             if rjob.client_key:
                 self._bound[(rjob.client_key, rjob.client_job_id)] = (
                     job.job_id
@@ -689,6 +809,7 @@ class Coordinator:
                     "mode": w.result.mode.value, "n": w.result.nonce,
                     "h": f"{w.result.hash_value:x}",
                     "found": w.result.found, "s": w.result.searched,
+                    "ts": w.ts,
                 }]
                 for (ck, cj), w in self._winners.items()
             ],
@@ -858,6 +979,11 @@ class Coordinator:
         last = self.stats["hashes"]
         while True:
             await asyncio.sleep(self._stats_interval)
+            # bounded-state sweeps that must advance even while no
+            # requests arrive: the age bound on the dedup table and the
+            # UNBOUND-residue reaper (ISSUE 13)
+            self._reap_unbound()
+            self._trim_winners()
             cur = self.stats["hashes"]
             if self._rotation and not self._miners:
                 # queued work and NOBODY to mine it. On a single-loop
@@ -897,6 +1023,7 @@ class Coordinator:
             "audits_queued": len(self._audit_queue) + len(self._audits),
             "boot_epoch": self._server.boot_epoch,
             "winners_cached": len(self._winners),
+            "quota_buckets": len(self._buckets),
         }
         if self._journal is not None:
             snap["journal"] = dict(self._journal.stats)
@@ -1048,6 +1175,11 @@ class Coordinator:
                 log.info("idle miner %d died", conn_id)
             self._schedule_dispatch()
             return
+        # an anonymous client's token bucket is keyed by its conn, so
+        # its session loss is the identity's end: reap it now (durable
+        # ckey buckets persist across redials by design — a redial must
+        # not refill quota — and are LRU-bounded instead)
+        self._buckets.pop(f"@conn:{conn_id}", None)
         job_ids = self._clients.pop(conn_id, None)
         if job_ids:
             dropped = []
@@ -1058,6 +1190,11 @@ class Coordinator:
                     # the job mining UNBOUND; its answer waits in the
                     # winners table (exactly-once across the redial)
                     job.client_conn = UNBOUND
+                    if self._unbound_ttl:
+                        job.unbound_since = time.monotonic()
+                        self._unbound_q.append(
+                            (job.unbound_since, job.job_id)
+                        )
                 else:
                     self._abandon_job(job_id)
                     dropped.append(job_id)
@@ -1069,6 +1206,168 @@ class Coordinator:
             # other clients' queued jobs must not wait for an unrelated
             # event to claim them (ADVICE.md r1)
             self._schedule_dispatch()
+        self._reap_unbound()
+
+    # -- admission & bounded state (ISSUE 13) ----------------------------
+
+    def _hw(self, key: str, value: int) -> None:
+        if value > self.stats[key]:
+            self.stats[key] = value
+
+    def _tier(self, ckey: str) -> float:
+        """Priority-tier multiplier from the ckey's ``tier:`` prefix
+        (no prefix, or an unknown one, is tier 1.0)."""
+        if ":" in ckey:
+            return self._quota_tiers.get(ckey.split(":", 1)[0], 1.0)
+        return 1.0
+
+    def _admit(self, conn_id: int, msg: Request) -> int:
+        """Admission check for a NEW submission (dedup hits and
+        re-binds are never charged — they mint no work). Returns 0 to
+        admit, else the retry_after_ms to Refuse with."""
+        if self._max_jobs and len(self._jobs) >= self._max_jobs:
+            if not self._shed_one():
+                # full of jobs that are all making progress: nothing
+                # shedable, the newcomer waits
+                return self._retry_after_ms
+        if self._quota_rate <= 0:
+            return 0
+        ckey = msg.client_key or f"@conn:{conn_id}"
+        tier = self._tier(ckey)
+        rate = self._quota_rate * tier
+        burst = max(1.0, self._quota_burst * tier)
+        now = time.monotonic()
+        bucket = self._buckets.pop(ckey, None)
+        if bucket is None:
+            tokens, strikes = burst, 0
+        else:
+            tokens, last, strikes = bucket
+            tokens = min(burst, tokens + (now - last) * rate)
+        if tokens >= 1.0:
+            tokens -= 1.0
+            ms = 0
+            strikes = 0
+        else:
+            # exact accrual time for the missing fraction of a token,
+            # escalated exponentially while the client keeps hammering:
+            # an open-loop source re-submitting every Refuse would
+            # otherwise flood the loop with refusal traffic at
+            # N_pending / retry_after — which is the overload we are
+            # refusing to prevent. Admission resets the strike count.
+            ms = min(
+                MAX_RETRY_AFTER_MS,
+                max(1, int((1.0 - tokens) / rate * 1000.0))
+                << min(strikes, 8),
+            )
+            strikes += 1
+        self._buckets[ckey] = (tokens, now, strikes)  # re-insert = LRU touch
+        while len(self._buckets) > QUOTA_BUCKETS_CAP:
+            self._buckets.popitem(last=False)
+        self._hw("quota_buckets_high_water", len(self._buckets))
+        return ms
+
+    def _send_refuse(
+        self, conn_id: int, client_job_id: int, retry_ms: int
+    ) -> None:
+        """Explicit backpressure: Refuse{retry_after_ms} to a client
+        (echoing ITS job id; chunk_id 0 marks the admission dialect)."""
+        try:
+            self._server.write(
+                conn_id,
+                encode_msg(Refuse(client_job_id, 0, retry_after_ms=retry_ms)),
+            )
+        except ConnectionError:
+            pass  # died before hearing no; nothing to clean up yet
+
+    def _shed_one(self) -> bool:
+        """LRU-shed one zero-progress pending job to make room under
+        ``max_jobs``: UNBOUND victims first (nobody is waiting on
+        them), else the oldest bound one — its client gets an explicit
+        Refuse{retry_after_ms} and re-submits later. Jobs with any
+        progress (settled hashes, in-flight chunks, pending audits or
+        verifications) are never shed: abandoning them wastes work."""
+        victim = None
+        for job in self._jobs.values():  # dict order = creation order
+            if (
+                job.done or job.hashes_done or job.inflight
+                or job.pending_audits or job.pending_verifications
+            ):
+                continue
+            if job.client_conn == UNBOUND:
+                victim = job
+                break
+            if victim is None:
+                victim = job
+        if victim is None:
+            return False
+        if victim.client_conn != UNBOUND:
+            self._send_refuse(
+                victim.client_conn, victim.client_job_id,
+                self._retry_after_ms,
+            )
+        self.stats["jobs_shed"] += 1
+        log.info(
+            "shed pending job %d (over --max-jobs=%d)",
+            victim.job_id, self._max_jobs,
+        )
+        self._abandon_job(victim.job_id)
+        return True
+
+    def _trim_winners(self) -> None:
+        """Enforce the dedup-table bounds: size (``winners_cap``) and
+        age (``winners_ttl``). ONLY acknowledged entries — durable on
+        disk with no parked re-submitters — are evictable; an un-acked
+        winner evicted here could be answered twice (once from the
+        pending durability callback, once re-mined after the table
+        forgot it), so it is held regardless of the bounds."""
+        if len(self._winners) <= self._winners_cap and not self._winners_ttl:
+            return
+        evictable = [
+            key for key, w in self._winners.items()
+            if w.durable and not w.waiters
+        ]
+        excess = len(self._winners) - self._winners_cap
+        evicted = 0
+        for key in evictable[:max(0, excess)]:
+            del self._winners[key]
+            evicted += 1
+        if self._winners_ttl:
+            cutoff = time.time() - self._winners_ttl
+            for key in evictable[max(0, excess):]:
+                w = self._winners.get(key)
+                if w is not None and w.ts <= cutoff:
+                    del self._winners[key]
+                    evicted += 1
+        if evicted:
+            self.stats["winners_evicted"] += evicted
+
+    def _reap_unbound(self) -> None:
+        """Drain the UNBOUND-residue queue: abandon durable jobs whose
+        client has been gone longer than ``unbound_ttl``. Exactly-once
+        is untouched — abandoning pops the (ckey, cjid) binding, so a
+        client that DOES come back later mints a fresh job and re-mines
+        (work re-done, never a duplicate answer)."""
+        if not self._unbound_ttl:
+            return
+        now = time.monotonic()
+        while (
+            self._unbound_q
+            and now - self._unbound_q[0][0] >= self._unbound_ttl
+        ):
+            ts, job_id = self._unbound_q.popleft()
+            job = self._jobs.get(job_id)
+            if (
+                job is None or job.done
+                or job.client_conn != UNBOUND
+                or job.unbound_since != ts
+            ):
+                continue  # retired, re-bound, or superseded entry
+            self.stats["unbound_reaped"] += 1
+            log.info(
+                "reaped UNBOUND job %d (client gone %.1fs > ttl %.1fs)",
+                job_id, now - ts, self._unbound_ttl,
+            )
+            self._abandon_job(job_id)
 
     # -- job lifecycle ---------------------------------------------------
 
@@ -1106,6 +1405,16 @@ class Coordinator:
                     # of mining a duplicate
                     self._rebind_job(job, conn_id)
                     return
+        self._reap_unbound()
+        retry_ms = self._admit(conn_id, msg)
+        if retry_ms:
+            self.stats["refused_admission"] += 1
+            log.info(
+                "refused admission for client %d job %d (retry in %d ms)",
+                conn_id, msg.job_id, retry_ms,
+            )
+            self._send_refuse(conn_id, msg.job_id, retry_ms)
+            return
         job_id = self._next_job_id
         self._next_job_id += self._job_id_stride
         job = _Job(
@@ -1117,6 +1426,8 @@ class Coordinator:
         job.ranges.append((msg.lower, msg.upper))
         self._jobs[job_id] = job
         self._clients.setdefault(conn_id, set()).add(job_id)
+        self._hw("jobs_high_water", len(self._jobs))
+        self._hw("sessions_high_water", len(self._clients))
         if msg.client_key:
             self._bound[(msg.client_key, msg.job_id)] = job_id
         self._rotation.append(job_id)
@@ -1138,7 +1449,9 @@ class Coordinator:
             if jobs is not None:
                 jobs.discard(job.job_id)
         job.client_conn = conn_id
+        job.unbound_since = 0.0  # re-bound: out of the residue reaper
         self._clients.setdefault(conn_id, set()).add(job.job_id)
+        self._hw("sessions_high_water", len(self._clients))
         self._journal_append("bind", {"id": job.job_id})
         log.info(
             "client %d re-bound to running job %d", conn_id, job.job_id
@@ -1642,8 +1955,8 @@ class Coordinator:
             self._winners.pop(key, None)
             winner = _Winner(result, durable=self._journal is None)
             self._winners[key] = winner
-            while len(self._winners) > WINNERS_CAP:
-                self._winners.popitem(last=False)
+            self._hw("winners_high_water", len(self._winners))
+            self._trim_winners()
         client_conn = job.client_conn
         if self._journal is not None:
             # WAL discipline: the client sees the answer only after the
@@ -1672,6 +1985,11 @@ class Coordinator:
                     "mode": job.request.mode.value, "n": nonce,
                     "h": f"{hash_value:x}", "found": found,
                     "s": job.hashes_done,
+                    # wall-clock birth of the dedup entry: the age
+                    # bound must survive replay (winner is None when
+                    # the job has no ckey — then nothing entered the
+                    # table and the ts is moot)
+                    "ts": winner.ts if winner is not None else time.time(),
                 },
                 on_durable=on_durable,
             )
@@ -2124,6 +2442,53 @@ def main(argv: Optional[list] = None) -> None:
         "(README 'Replication')",
     )
     parser.add_argument(
+        "--quota-rate", type=float, default=0.0, metavar="R",
+        help="admission control: job submissions per second each "
+        "client identity may sustain (token bucket per ckey; 0 = off, "
+        "the default). Over-quota submissions are answered with "
+        "Refuse{retry_after_ms} instead of a job (README 'Admission & "
+        "overload')",
+    )
+    parser.add_argument(
+        "--quota-burst", type=int, default=8, metavar="N",
+        help="token-bucket capacity: submissions a client may burst "
+        "before the per-second rate applies (default 8)",
+    )
+    parser.add_argument(
+        "--quota-tier", action="append", default=None,
+        metavar="NAME=MULT",
+        help="priority tier: clients whose ckey starts with 'NAME:' "
+        "get MULT x the quota rate and burst (repeatable, e.g. "
+        "--quota-tier gold=4 --quota-tier bulk=0.25)",
+    )
+    parser.add_argument(
+        "--max-jobs", type=int, default=0, metavar="N",
+        help="hard cap on live jobs (0 = unbounded). At the cap, a new "
+        "submission LRU-sheds a zero-progress pending job back to "
+        "Refuse{retry_after_ms}, or is itself refused when every job "
+        "has progress",
+    )
+    parser.add_argument(
+        "--retry-after-ms", type=int, default=DEFAULT_RETRY_AFTER_MS,
+        metavar="MS",
+        help="base retry-after suggestion on capacity refusals "
+        f"(default {DEFAULT_RETRY_AFTER_MS}; quota refusals compute "
+        "the exact token-accrual time instead)",
+    )
+    parser.add_argument(
+        "--winners-ttl", type=float, default=0.0, metavar="SECONDS",
+        help="age bound on the exactly-once winner/dedup table (0 = "
+        "size bound only). An un-acknowledged winner is never evicted "
+        "regardless",
+    )
+    parser.add_argument(
+        "--unbound-ttl", type=float, default=0.0, metavar="SECONDS",
+        help="reap a durable client's job this long after its client "
+        "vanished without re-binding (0 = keep forever). Bounds the "
+        "residue a churn storm of dying clients leaves behind; a "
+        "client that returns later simply re-mines",
+    )
+    parser.add_argument(
         "--replica-ack", action="store_true",
         help="with --replicate-to: hold each winner acknowledgement "
         "until a standby confirms the finish record, so an answered "
@@ -2143,6 +2508,18 @@ def main(argv: Optional[list] = None) -> None:
             parse_addr_list(args.replicate_to)
             if args.replicate_to else None
         )
+        quota_tiers = {}
+        for spec in args.quota_tier or ():
+            name, _, mult = spec.partition("=")
+            if not name or not mult:
+                parser.error(f"--quota-tier wants NAME=MULT, got {spec!r}")
+            quota_tiers[name] = float(mult)
+        admission = dict(
+            quota_rate=args.quota_rate, quota_burst=args.quota_burst,
+            quota_tiers=quota_tiers, max_jobs=args.max_jobs,
+            retry_after_ms=args.retry_after_ms,
+            winners_ttl=args.winners_ttl, unbound_ttl=args.unbound_ttl,
+        )
         if args.loops > 1:
             from tpuminter.multiloop import MultiLoopCoordinator
 
@@ -2160,6 +2537,7 @@ def main(argv: Optional[list] = None) -> None:
                 replicate_to=replicate_to,
                 replica_ack=args.replica_ack,
                 io_batch=args.io_batch == "on",
+                **admission,
             )
             log.info(
                 "coordinator listening on port %d (%d loops)",
@@ -2196,6 +2574,7 @@ def main(argv: Optional[list] = None) -> None:
             replicate_to=replicate_to,
             replica_ack=args.replica_ack,
             io_batch=args.io_batch == "on",
+            **admission,
         )
         log.info("coordinator listening on port %d", coord.port)
         if args.stats_port is not None:

@@ -412,6 +412,13 @@ class RecoveredState:
     #: job ids seen finishing/abandoned — guards job-record idempotency
     finished: Set[int] = field(default_factory=set)
     records: int = 0
+    #: size bound applied to ``winners`` while folding records (ISSUE
+    #: 13: cap-aware replay — a coordinator running a smaller dedup
+    #: table must rebuild the SAME bounded view after a crash, not a
+    #: bigger one). Insertion-ordered trim, exactly the live table's
+    #: policy; replayed winners are all acknowledged, so the live
+    #: rule's un-acked exemption is vacuous here.
+    winners_cap: int = WINNERS_CAP
 
     def apply(self, rec: dict) -> None:
         k = rec["k"]
@@ -462,7 +469,7 @@ class RecoveredState:
                 key = (ckey, int(rec["cjid"]))
                 self.winners.pop(key, None)
                 self.winners[key] = rec
-                while len(self.winners) > WINNERS_CAP:
+                while len(self.winners) > self.winners_cap:
                     self.winners.popitem(last=False)
         elif k == "abandon":
             job_id = int(rec["id"])
@@ -484,10 +491,12 @@ class RecoveredState:
         }
 
 
-def replay(records: List[dict]) -> RecoveredState:
+def replay(
+    records: List[dict], *, winners_cap: int = WINNERS_CAP
+) -> RecoveredState:
     """Fold a record sequence into a :class:`RecoveredState` (pure,
     idempotent: ``replay(r + r)`` equals ``replay(r)``)."""
-    state = RecoveredState()
+    state = RecoveredState(winners_cap=winners_cap)
     for rec in records:
         state.apply(rec)
     return state
@@ -527,7 +536,10 @@ def merge_states(states: List[RecoveredState]) -> RecoveredState:
     shrink it; anything either stream still calls un-mined re-mines),
     the min-fold takes the smaller best, hashes take the max. A job any
     stream saw finish/abandon stays finished everywhere."""
-    out = RecoveredState()
+    out = RecoveredState(
+        winners_cap=max((st.winners_cap for st in states),
+                        default=WINNERS_CAP),
+    )
     for st in states:
         out.boot_epoch = max(out.boot_epoch, st.boot_epoch)
         out.next_job_id = max(out.next_job_id, st.next_job_id)
@@ -553,7 +565,7 @@ def merge_states(states: List[RecoveredState]) -> RecoveredState:
             out.winners[key] = dict(w)
     for jid in out.finished:
         out.jobs.pop(jid, None)
-    while len(out.winners) > WINNERS_CAP:
+    while len(out.winners) > out.winners_cap:
         out.winners.popitem(last=False)
     return out
 
@@ -643,14 +655,18 @@ class Journal:
     # -- construction ----------------------------------------------------
 
     @classmethod
-    def open(cls, path: str, **kwargs) -> Tuple["Journal", RecoveredState]:
+    def open(
+        cls, path: str, *, winners_cap: int = WINNERS_CAP, **kwargs
+    ) -> Tuple["Journal", RecoveredState]:
         """Open (or create) the journal at ``path`` and replay it.
 
         Any per-loop WAL segments a sharded run left next to it
         (``path.s<k>``, tpuminter.multiloop's segmented journal mode)
         are merged into the recovered state, re-snapshotted into this
         file, and deleted — a restart may freely cross journal modes
-        and loop counts without losing coverage."""
+        and loop counts without losing coverage. ``winners_cap`` bounds
+        the rebuilt dedup table to the caller's live policy (ISSUE 13:
+        replay must land on the same bounded view)."""
         records: List[dict] = []
         if os.path.exists(path):
             with open(path, "rb") as fh:
@@ -661,11 +677,15 @@ class Journal:
                 # in place so the file is a clean prefix again
                 with open(path, "r+b") as fh:
                     fh.truncate(clean)
-        state = replay(records)
+        state = replay(records, winners_cap=winners_cap)
         seg_paths = segment_paths(path)
         if seg_paths:
             state = merge_states(
-                [state] + [replay(scan_file(p)) for p in seg_paths]
+                [state]
+                + [
+                    replay(scan_file(p), winners_cap=winners_cap)
+                    for p in seg_paths
+                ]
             )
         state.boot_epoch += 1
         journal = cls(path, **kwargs)

@@ -464,6 +464,14 @@ class MultiLoopCoordinator:
         replicate_to: Optional[List[Tuple[str, int]]] = None,
         replica_ack: bool = False,
         io_batch: Optional[bool] = None,
+        quota_rate: float = 0.0,
+        quota_burst: int = 8,
+        quota_tiers: Optional[dict] = None,
+        max_jobs: int = 0,
+        retry_after_ms: Optional[int] = None,
+        winners_cap: Optional[int] = None,
+        winners_ttl: float = 0.0,
+        unbound_ttl: float = 0.0,
     ) -> "MultiLoopCoordinator":
         if loops < 1:
             raise ValueError("loops must be >= 1")
@@ -546,7 +554,24 @@ class MultiLoopCoordinator:
             hedge_after=hedge_after, audit_rate=audit_rate,
             stats_interval=stats_interval, journal_assigns=journal_assigns,
             binary_codec=binary_codec, journal_tick_flush=journal_tick_flush,
+            # admission & bounded state (ISSUE 13): quota accounting is
+            # SHARD-AFFINE by design — a peer is steered to one shard by
+            # its stable address hash, so its token bucket lives (only)
+            # where its submissions land; per-shard caps mean the
+            # aggregate bound is cap × loops. A redialed client may land
+            # on a different shard with a fresh bucket — the quota leak
+            # is one burst per redial, the price of zero cross-shard
+            # coordination on the admission hot path (same trade the
+            # dedup table made the other way: winners replicate to every
+            # shard at recovery because correctness needs them).
+            quota_rate=quota_rate, quota_burst=quota_burst,
+            quota_tiers=quota_tiers, max_jobs=max_jobs,
+            winners_ttl=winners_ttl, unbound_ttl=unbound_ttl,
         )
+        if retry_after_ms is not None:
+            coord_kwargs["retry_after_ms"] = retry_after_ms
+        if winners_cap is not None:
+            coord_kwargs["winners_cap"] = winners_cap
         if chunk_size is not None:
             coord_kwargs["chunk_size"] = chunk_size
         if pipeline_depth is not None:

@@ -286,10 +286,21 @@ class Refuse:
     ever Setup this worker for the job, and the next dispatch re-ships
     the template. Without it, any cache/`setup_sent` divergence (however
     caused) would wedge the worker busy-forever on a silently-dropped
-    Assign."""
+    Assign.
+
+    Coordinator → client (``retry_after_ms > 0``): admission control's
+    explicit backpressure — the submission was refused (over-quota or
+    over-capacity), come back after roughly ``retry_after_ms``
+    milliseconds with jitter. Echoes the CLIENT's job_id (chunk_id 0).
+    Clients honor it with jittered backoff and a re-submit; it never
+    counts toward any eviction threshold (an admission Refuse is the
+    coordinator doing its job, not a peer misbehaving)."""
 
     job_id: int
     chunk_id: int
+    #: 0 = the classic worker-side template refusal; > 0 = an admission
+    #: refusal carrying the coordinator's suggested retry delay
+    retry_after_ms: int = 0
 
 
 @dataclass(frozen=True)
@@ -404,6 +415,11 @@ _TAG_RESULT = 0xB2
 _TAG_REFUSE = 0xB3
 _TAG_CANCEL = 0xB4
 _TAG_JOIN = 0xB5
+#: Refuse carrying an admission retry-after hint (ISSUE 13). A separate
+#: tag, not a new layout for 0xB3: v1 tags never change meaning, and an
+#: old peer that has never heard of 0xB6 fails the unknown-tag check
+#: loudly instead of misparsing a longer 0xB3.
+_TAG_REFUSE_WAIT = 0xB6
 # 0xB7 is reserved by tpuminter.journal for its packed settle record
 # (same '{'-disjoint tag space, so a journal payload can never be
 # confused with a wire message and vice versa).
@@ -424,6 +440,7 @@ _BIN_RESULT = struct.Struct("<BBQQ32sBQQ")   # tag, mode, job, nonce,
 #                                              hash (u256 LE), found,
 #                                              searched, chunk
 _BIN_REFUSE = struct.Struct("<BQQ")          # tag, job, chunk
+_BIN_REFUSE_WAIT = struct.Struct("<BQQI")    # tag, job, chunk, retry_ms
 _BIN_CANCEL = struct.Struct("<BQ")           # tag, job
 _BIN_JOIN = struct.Struct("<BBIQ16s")        # tag, flags, lanes, span,
 #                                              backend (NUL-padded utf8)
@@ -434,6 +451,7 @@ _BIN_BY_TAG = {
     _TAG_ASSIGN: _BIN_ASSIGN,
     _TAG_RESULT: _BIN_RESULT,
     _TAG_REFUSE: _BIN_REFUSE,
+    _TAG_REFUSE_WAIT: _BIN_REFUSE_WAIT,
     _TAG_CANCEL: _BIN_CANCEL,
     _TAG_JOIN: _BIN_JOIN,
 }
@@ -491,8 +509,14 @@ def _encode_binary(msg: Message) -> Optional[bytes]:
             msg.searched, msg.chunk_id,
         ))
     if isinstance(msg, Refuse):
-        if not (0 <= msg.job_id < _U64 and 0 <= msg.chunk_id < _U64):
+        if not (0 <= msg.job_id < _U64 and 0 <= msg.chunk_id < _U64
+                and 0 <= msg.retry_after_ms < (1 << 32)):
             return None
+        if msg.retry_after_ms:
+            return _seal(_BIN_REFUSE_WAIT.pack(
+                _TAG_REFUSE_WAIT, msg.job_id, msg.chunk_id,
+                msg.retry_after_ms,
+            ))
         return _seal(_BIN_REFUSE.pack(_TAG_REFUSE, msg.job_id, msg.chunk_id))
     if isinstance(msg, Cancel):
         if not 0 <= msg.job_id < _U64:
@@ -563,6 +587,9 @@ def _decode_binary(raw) -> Message:
         if tag == _TAG_REFUSE:
             _, job_id, chunk_id = _BIN_REFUSE.unpack_from(raw)
             return Refuse(job_id, chunk_id)
+        if tag == _TAG_REFUSE_WAIT:
+            _, job_id, chunk_id, retry_ms = _BIN_REFUSE_WAIT.unpack_from(raw)
+            return Refuse(job_id, chunk_id, retry_after_ms=retry_ms)
         if tag == _TAG_CANCEL:
             (_, job_id) = _BIN_CANCEL.unpack_from(raw)
             return Cancel(job_id)
@@ -664,6 +691,8 @@ def encode_msg(msg: Message, *, binary: bool = False) -> bytes:
         }
     elif isinstance(msg, Refuse):
         obj = {"kind": "refuse", "job_id": msg.job_id, "chunk_id": msg.chunk_id}
+        if msg.retry_after_ms:
+            obj["retry_after_ms"] = msg.retry_after_ms
     elif isinstance(msg, Result):
         obj = {
             "kind": "result",
@@ -743,7 +772,10 @@ def decode_msg(raw) -> Message:
                 upper=int(obj["upper"]),
             )
         if kind == "refuse":
-            return Refuse(job_id=int(obj["job_id"]), chunk_id=int(obj["chunk_id"]))
+            return Refuse(
+                job_id=int(obj["job_id"]), chunk_id=int(obj["chunk_id"]),
+                retry_after_ms=int(obj.get("retry_after_ms", 0)),
+            )
         if kind == "rhello":
             return RepHello(epoch=int(obj["epoch"]))
         if kind == "syncfrom":
