@@ -1073,6 +1073,65 @@ def bench_rolled(pairs: int = 5, nb_points=(8, 12), width: int = 256,
     return out
 
 
+def bench_rolled_cp(duration: float = 1.5, smoke: bool = False) -> dict:
+    """Roll-budget chunking control-plane A/B (ISSUE 14), CPU-only like
+    the other loadgen-backed sections: wire bytes and control messages
+    per unit of rolled work, budgeted RollAssign dispatch vs the
+    global-index-chunk baseline, measured PAIRED in one ``run_rolled``
+    invocation per ``nonce_bits`` point.
+
+    - ``rolled_cp_msgs_per_segment_{budget,classic}_nb{20,32}`` /
+      ``rolled_cp_bytes_per_segment_*`` — control messages and wire
+      bytes per settled 2^nonce_bits-index segment, both arms. nb=32
+      is the production shape (the ISSUE 14 >= 1000x acceptance bar);
+      nb=20 is the shrunken regime the e2e/property suites run in,
+      kept on the ledger so the collapse's segment-size scaling stays
+      visible.
+    - ``rolled_cp_collapse_ratio_msgs_nb*`` — classic over budgeted,
+      the headline dispatch-count collapse.
+    - ``rolled_cp_beacon_overhead_pct_nb*`` — accepted Beacons as a
+      percentage of accepted Results in the budgeted arm (the <= 5%
+      sub-chunk progress budget).
+    - ``rolled_cp_violations_nb*`` — the full ``rolled_check`` verdict
+      count; 0 = every engagement/isolation/overhead gate held.
+    """
+    import asyncio
+
+    loadgen = _import_loadgen()
+
+    out = {}
+    for nb in (20, 32):
+        m = asyncio.run(loadgen.run_rolled(
+            8, 2 if smoke else 4, duration, nonce_bits=nb,
+        ))
+        roll, classic = m["roll"], m["classic"]
+        out.update({
+            f"rolled_cp_msgs_per_segment_budget_nb{nb}": (
+                roll["ctrl_msgs_per_segment"]
+            ),
+            f"rolled_cp_msgs_per_segment_classic_nb{nb}": (
+                classic["ctrl_msgs_per_segment"]
+            ),
+            f"rolled_cp_bytes_per_segment_budget_nb{nb}": (
+                roll["wire_bytes_per_segment"]
+            ),
+            f"rolled_cp_bytes_per_segment_classic_nb{nb}": (
+                classic["wire_bytes_per_segment"]
+            ),
+            f"rolled_cp_collapse_ratio_msgs_nb{nb}": (
+                m["collapse_ratio_msgs"]
+            ),
+            f"rolled_cp_collapse_ratio_bytes_nb{nb}": (
+                m["collapse_ratio_bytes"]
+            ),
+            f"rolled_cp_beacon_overhead_pct_nb{nb}": (
+                roll["beacon_overhead_pct"]
+            ),
+            f"rolled_cp_violations_nb{nb}": len(loadgen.rolled_check(m)),
+        })
+    return out
+
+
 def bench_native(seconds: float = 2.0) -> dict:
     """Measured native C++ double-SHA rate (README's backend table row;
     BASELINE.md quoted 1.84 MH/s on this host). Absent .so → empty."""
@@ -1141,6 +1200,7 @@ def main() -> None:
         extra.update(bench_chaos(duration=1.0, smoke=True))
         extra.update(bench_admission(smoke=True))
         extra.update(bench_rolled(pairs=1, nb_points=(8,)))
+        extra.update(bench_rolled_cp(duration=1.0, smoke=True))
         extra.update(bench_native(seconds=0.5))
     elif jax.default_backend() == "cpu":
         # the TPU tunnel is down and jax silently fell back to CPU: say
@@ -1159,6 +1219,7 @@ def main() -> None:
         extra.update(bench_chaos())
         extra.update(bench_admission())
         extra.update(bench_rolled())
+        extra.update(bench_rolled_cp())
         extra.update(bench_native())
     else:
         # persistent compilation cache, same as the worker CLI: the
@@ -1192,6 +1253,7 @@ def main() -> None:
         extra.update(bench_chaos())
         extra.update(bench_admission())
         extra.update(bench_rolled())
+        extra.update(bench_rolled_cp())
         extra.update(bench_native())
     ghs = rate / 1e9
     print(

@@ -113,13 +113,16 @@ from tpuminter.lsp import (  # noqa: E402
 )
 from tpuminter.lsp.params import FAST, jittered_backoff  # noqa: E402
 from tpuminter.protocol import (  # noqa: E402
+    MIN_UNTRACKED,
     Assign,
+    Beacon,
     Cancel,
     Join,
     PowMode,
     Refuse,
     Request,
     Result,
+    RollAssign,
     Setup,
     codec_stats,
     decode_msg,
@@ -701,6 +704,327 @@ def smoke_check(metrics: dict, params: Params = FAST) -> list:
                 f"{metrics['fleet']}: partitioning is not spreading "
                 f"({shards})"
             )
+    return bad
+
+
+# ---------------------------------------------------------------------------
+# rolled scenario (ISSUE 14): roll-budget chunking, paired A/B
+
+
+async def _instant_roll_miner(
+    port: int, params: Params, *, binary: bool = True,
+    beacon_every: int = 25, sent: Optional[dict] = None,
+) -> None:
+    """An instant miner that speaks the roll dialect: Join with
+    ``roll=True``, cache Setup templates, and settle every Assign AND
+    RollAssign immediately with the ``found=False, MIN_UNTRACKED``
+    exhaustion sentinel (the fast-path "swept, no winner, min
+    untracked" claim the coordinator's verifier accepts for targeted
+    modes). Jobs therefore finish by exhaustion and the run measures
+    pure dispatch accounting — no mining, no host hashing.
+
+    Every ``beacon_every``-th RollAssign additionally ships one
+    mid-chunk :class:`Beacon` (settled prefix = the chunk's lower
+    half) BEFORE its final Result, so the run books the beacon path's
+    real verify/journal/advance cost at a known <= 1/beacon_every
+    cadence. ``sent['n']`` counts beacons written, so a check can pin
+    accepted == sent (none dropped as stale/unverifiable)."""
+    w = await LspClient.connect("127.0.0.1", port, params)
+    w.write(encode_msg(Join(
+        backend="instant", lanes=1, codec="bin" if binary else "json",
+        roll=True,
+    )))
+    templates = {}
+    speak = {"binary": False}
+    rolls = {"n": 0}
+
+    def settle(job_id, chunk_id, lower, upper, mode) -> None:
+        w.write(encode_msg(Result(
+            job_id, mode, nonce=lower, hash_value=MIN_UNTRACKED,
+            found=False, searched=upper - lower + 1, chunk_id=chunk_id,
+        ), binary=speak["binary"]))
+
+    def handle(raw) -> None:
+        if binary and not speak["binary"] and payload_is_binary(raw):
+            speak["binary"] = True
+        msg = decode_msg(raw)
+        if isinstance(msg, Setup):
+            templates[msg.request.job_id] = msg.request
+        elif isinstance(msg, Cancel):
+            templates.pop(msg.job_id, None)
+        elif isinstance(msg, Assign):
+            req = templates.get(msg.job_id)
+            if req is not None:
+                settle(msg.job_id, msg.chunk_id, msg.lower, msg.upper,
+                       req.mode)
+        elif isinstance(msg, RollAssign):
+            req = templates.get(msg.job_id)
+            if req is None:
+                return
+            lower, upper = chain.roll_span(
+                msg.extranonce0, msg.count, req.nonce_bits
+            )
+            rolls["n"] += 1
+            if rolls["n"] % beacon_every == 0:
+                mid = lower + (upper - lower) // 2
+                w.write(encode_msg(Beacon(
+                    msg.job_id, msg.chunk_id, mid, lower, MIN_UNTRACKED,
+                ), binary=speak["binary"]))
+                if sent is not None:
+                    sent["n"] += 1
+            settle(msg.job_id, msg.chunk_id, lower, upper, req.mode)
+
+    try:
+        while True:
+            raw = await w.read()
+            while raw is not None:
+                handle(raw)
+                raw = (
+                    w.read_nowait() if hasattr(w, "read_nowait") else None
+                )
+    except LspConnectionLost:
+        pass
+    finally:
+        await w.close(drain_timeout=0.2)
+
+
+async def _rolled_client(port: int, params: Params, cid: int,
+                         upper: int, counter: dict,
+                         nonce_bits: int = 32) -> None:
+    """Closed-loop client submitting production-shaped rolled TARGET
+    jobs: unreachable ``target=1`` (no instant-fleet sentinel can ever
+    claim a win), so every job runs to exhaustion and its answer is
+    the coordinator's own coverage bookkeeping."""
+    c = await LspClient.connect("127.0.0.1", port, params)
+    try:
+        jid = 0
+        while True:
+            jid += 1
+            c.write(encode_msg(Request(
+                job_id=jid, mode=PowMode.TARGET, lower=0, upper=upper,
+                header=bytes(80), target=1,
+                coinbase_prefix=b"loadgen-roll-%d" % cid,
+                coinbase_suffix=b"-cb", extranonce_size=4,
+                nonce_bits=nonce_bits,
+            )))
+            while True:
+                msg = decode_msg(await c.read())
+                if isinstance(msg, Result) and msg.job_id == jid:
+                    break
+            counter["jobs"] += 1
+    except (LspConnectionLost, asyncio.CancelledError):
+        pass
+    finally:
+        await c.close(drain_timeout=0.2)
+
+
+async def _run_rolled_arm(
+    n_miners: int, n_clients: int, duration: float, *,
+    chunk_size: int, roll_budget: int, segments: int,
+    beacon_every: int, binary: bool, pipeline_depth: int,
+    nonce_bits: int = 32, warmup: float = 0.4,
+) -> dict:
+    """One arm of the rolled A/B: a real coordinator with the given
+    ``roll_budget`` (0 = the global-index-chunk baseline) under a
+    roll-capable instant fleet and rolled closed-loop clients. Reports
+    control messages and wire bytes NORMALIZED per settled extranonce
+    SEGMENT (2^nonce_bits indices — 2^32 in production), which is what
+    makes the two arms comparable: at ``nonce_bits=32`` the baseline
+    settles a fraction of a segment per second at ``chunk_size``
+    granularity while the rolled arm settles thousands."""
+    coord = await make_coordinator(
+        params=FAST, chunk_size=chunk_size, binary_codec=binary,
+        pipeline_depth=pipeline_depth, roll_budget=roll_budget,
+    )
+    serve = asyncio.ensure_future(coord.serve())
+    lost = {"n": 0}
+    _hook_lost_events(coord, lost)
+    sent = {"n": 0}
+    miners = [
+        asyncio.ensure_future(_instant_roll_miner(
+            coord.port, FAST, binary=binary, beacon_every=beacon_every,
+            sent=sent,
+        ))
+        for _ in range(n_miners)
+    ]
+    counter = {"jobs": 0}
+    upper = segments * (1 << nonce_bits) - 1
+    clients = [
+        asyncio.ensure_future(
+            _rolled_client(coord.port, FAST, i, upper, counter,
+                           nonce_bits=nonce_bits)
+        )
+        for i in range(n_clients)
+    ]
+    try:
+        await asyncio.sleep(warmup)
+        t0 = time.monotonic()
+        stats0 = dict(coord.stats)
+        chunks0 = coord._next_chunk_id
+        _, _, bytes0 = _ep_totals(coord)
+        codec0 = dict(codec_stats)
+        jobs0, sent0 = counter["jobs"], sent["n"]
+        await asyncio.sleep(duration)
+        dt = time.monotonic() - t0
+        stats1 = coord.stats
+        hashes = stats1["hashes"] - stats0["hashes"]
+        results = (
+            stats1["results_accepted"] - stats0["results_accepted"]
+        )
+        beacons = (
+            stats1["beacons_accepted"] - stats0["beacons_accepted"]
+        )
+        _, _, bytes1 = _ep_totals(coord)
+        msgs = sum(
+            codec_stats[k] - codec0[k]
+            for k in ("json_encoded", "json_decoded",
+                      "binary_encoded", "binary_decoded")
+        )
+        # work unit: one full 2^nonce_bits extranonce segment
+        units = hashes / float(1 << nonce_bits)
+        return {
+            "roll_budget": roll_budget,
+            "duration_s": round(dt, 3),
+            "results_per_s": round(results / dt, 1),
+            "jobs_completed": counter["jobs"] - jobs0,
+            "assigns": coord._next_chunk_id - chunks0,
+            "chunks_roll_dispatched": (
+                stats1["chunks_roll_dispatched"]
+                - stats0["chunks_roll_dispatched"]
+            ),
+            "beacons_sent": sent["n"] - sent0,
+            "beacons_accepted": beacons,
+            "beacon_overhead_pct": (
+                round(100.0 * beacons / results, 2) if results else 0.0
+            ),
+            "results_rejected": (
+                stats1["results_rejected"] - stats0["results_rejected"]
+            ),
+            "miners_lost": lost["n"],
+            "indices_settled": hashes,
+            "segments_settled": round(units, 4),
+            "ctrl_msgs": msgs,
+            "ctrl_msgs_per_segment": (
+                round(msgs / units, 3) if units else 0.0
+            ),
+            "wire_bytes": bytes1 - bytes0,
+            "wire_bytes_per_segment": (
+                round((bytes1 - bytes0) / units, 1) if units else 0.0
+            ),
+        }
+    finally:
+        for t in clients + miners:
+            t.cancel()
+        await asyncio.gather(*clients, *miners, return_exceptions=True)
+        serve.cancel()
+        await asyncio.gather(serve, return_exceptions=True)
+        await coord.close()
+
+
+async def run_rolled(
+    n_miners: int = 8,
+    n_clients: int = 4,
+    duration: float = 1.5,
+    *,
+    chunk_size: int = 16384,
+    roll_budget: int = 16,
+    segments: int = 64,
+    beacon_every: int = 25,
+    binary: bool = True,
+    pipeline_depth: int = 2,
+    nonce_bits: int = 32,
+) -> dict:
+    """Paired A/B of roll-budget chunking (ISSUE 14): the SAME fleet,
+    clients, and 64-segment rolled job shape, first with
+    ``roll_budget`` armed and then with the global-index-chunk
+    baseline (``roll_budget=0``) — one invocation, one ratio. The
+    headline ``collapse_ratio_msgs`` is control messages per settled
+    segment (2^nonce_bits indices; production is ``nonce_bits=32``),
+    baseline over rolled; the rolled arm also books beacon cost at a
+    1/``beacon_every`` cadence, so the overhead stays on the ledger.
+    The normalization is conservative toward the rolled arm: its
+    completed jobs keep paying Setup + client answer traffic while
+    the baseline's never-finishing jobs pay almost none."""
+    roll = await _run_rolled_arm(
+        n_miners, n_clients, duration, chunk_size=chunk_size,
+        roll_budget=roll_budget, segments=segments,
+        beacon_every=beacon_every, binary=binary,
+        pipeline_depth=pipeline_depth, nonce_bits=nonce_bits,
+    )
+    classic = await _run_rolled_arm(
+        n_miners, n_clients, duration, chunk_size=chunk_size,
+        roll_budget=0, segments=segments, beacon_every=beacon_every,
+        binary=binary, pipeline_depth=pipeline_depth,
+        nonce_bits=nonce_bits,
+    )
+
+    def ratio(key: str) -> float:
+        denom = roll[key]
+        return round(classic[key] / denom, 1) if denom else 0.0
+
+    return {
+        "nonce_bits": nonce_bits,
+        "segments_per_job": segments,
+        "chunk_size": chunk_size,
+        "codec": "binary" if binary else "json",
+        "collapse_ratio_msgs": ratio("ctrl_msgs_per_segment"),
+        "collapse_ratio_bytes": ratio("wire_bytes_per_segment"),
+        "roll": roll,
+        "classic": classic,
+    }
+
+
+def rolled_check(metrics: dict) -> list:
+    """The rolled scenario IS its assertions (like chaos/zipf): the
+    dispatch-count collapse must demonstrably ENGAGE — a silent
+    fallback to classic Assigns would pass every liveness check while
+    measuring nothing — and hold the ISSUE 14 bar of >= 1000x fewer
+    control messages per 2^32-index segment at beacon overhead <= 5%.
+    The collapse scales with the segment size, so shrunken
+    ``nonce_bits`` runs (the bench's 2^20 leg) gate at a
+    proportionally lower floor."""
+    bad = []
+    roll, classic = metrics["roll"], metrics["classic"]
+    for arm, m in (("roll", roll), ("classic", classic)):
+        if m["indices_settled"] <= 0:
+            bad.append(f"{arm} arm settled no indices: {m}")
+        if m["miners_lost"] > 0:
+            bad.append(f"{arm} arm lost {m['miners_lost']} miner(s)")
+        if m["results_rejected"] > 0:
+            bad.append(
+                f"{arm} arm rejected {m['results_rejected']} result(s)"
+            )
+    if roll["chunks_roll_dispatched"] <= 0:
+        bad.append(
+            "roll budget configured but no RollAssign ever dispatched "
+            "— silent fallback to classic chunking"
+        )
+    if classic["chunks_roll_dispatched"] > 0:
+        bad.append(
+            "baseline arm dispatched RollAssigns at roll_budget=0 — "
+            "the arms are not isolated"
+        )
+    if roll["beacons_accepted"] <= 0:
+        bad.append("rolled arm produced no accepted beacons")
+    if roll["beacons_accepted"] != roll["beacons_sent"]:
+        bad.append(
+            f"beacons sent {roll['beacons_sent']} != accepted "
+            f"{roll['beacons_accepted']}: some were dropped as "
+            f"stale/unverifiable"
+        )
+    if roll["beacon_overhead_pct"] > 5.0:
+        bad.append(
+            f"beacon overhead {roll['beacon_overhead_pct']}% of "
+            f"results/s exceeds the 5% budget"
+        )
+    floor = 1000.0 if metrics["nonce_bits"] >= 32 else 100.0
+    if metrics["collapse_ratio_msgs"] < floor:
+        bad.append(
+            f"control-message collapse {metrics['collapse_ratio_msgs']}x "
+            f"< {floor}x per 2^{metrics['nonce_bits']}-index segment "
+            f"(roll {roll['ctrl_msgs_per_segment']} vs classic "
+            f"{classic['ctrl_msgs_per_segment']})"
+        )
     return bad
 
 
@@ -2573,6 +2897,7 @@ def main(argv=None) -> int:
         "--scenario",
         choices=(
             "steady", "crash", "failover", "chaos", "zipf", "churn",
+            "rolled",
         ),
         default="steady",
         help="steady: the sustained-burst benchmark; crash: kill the "
@@ -2597,7 +2922,18 @@ def main(argv=None) -> int:
         "against a fully capped coordinator, kill -9 mid-churn — "
         "asserts every table high-water plateaus at a constant "
         "independent of client count, zero residue after the wash, "
-        "and cap-aware journal replay",
+        "and cap-aware journal replay; rolled: paired A/B of "
+        "roll-budget chunking — the same roll-capable instant fleet "
+        "and 64-segment nonce_bits=32 rolled jobs run once with "
+        "--roll-budget armed and once at budget 0 (global-index "
+        "chunks), gated on the RollAssign path demonstrably engaging, "
+        ">= 1000x fewer control messages per 2^32 settled indices, "
+        "and beacon overhead <= 5% of results/s",
+    )
+    parser.add_argument(
+        "--roll-budget", type=int, default=16, metavar="N",
+        help="rolled scenario: extranonce segments per RollAssign in "
+        "the armed arm (the baseline arm always runs at 0; default 16)",
     )
     parser.add_argument(
         "--seed", type=int, default=0,
@@ -2684,6 +3020,33 @@ def main(argv=None) -> int:
         binary=args.codec == "binary", pipeline_depth=args.pipeline,
         loops=args.loops, io_batch=args.io_batch == "on",
     )
+    if args.scenario == "rolled":
+        metrics = asyncio.run(run_rolled(
+            8 if args.smoke else args.miners,
+            max(2, args.clients),
+            duration=min(args.duration, 1.5) if args.smoke
+            else args.duration,
+            # production chunk-size default unless explicitly overridden
+            chunk_size=(
+                args.chunk_size if args.chunk_size != 1024 else 16384
+            ),
+            roll_budget=args.roll_budget,
+            binary=args.codec == "binary",
+            pipeline_depth=args.pipeline,
+        ))
+        print(json.dumps(metrics) if args.json else
+              "\n".join(
+                  [f"{k}: {v}" for k, v in metrics.items()
+                   if not isinstance(v, dict)]
+                  + [f"{arm}.{k}: {v}"
+                     for arm in ("roll", "classic")
+                     for k, v in metrics.get(arm, {}).items()]
+              ))
+        # the A/B IS its assertions, --smoke or not (like chaos/zipf)
+        violations = rolled_check(metrics)
+        for v in violations:
+            print(f"ROLLED FAIL: {v}", file=sys.stderr)
+        return 1 if violations else 0
     if args.scenario == "zipf":
         metrics = asyncio.run(run_zipf(
             4 if args.smoke else max(4, args.clients),

@@ -1,0 +1,32 @@
+"""True-positive fixture: a broken roll-budget dialect table (ISSUE 14).
+
+The shapes a careless RollAssign/Beacon port would produce: the beacon
+tag reuses the wire Result tag 0xB7 (a beacon would decode as a full
+chunk settle — silent over-settling), the roll-assign layout's total
+packed length collides with another fixed kind (length is the
+secondary dispatch key), nothing is sealed with a CRC, and the u64
+extranonce0 / high_water fields are packed with no range guard.
+Parsed by tests/test_analysis.py, never imported.
+"""
+
+import struct
+
+_TAG_RESULT = 0xB7
+_BIN_RESULT = struct.Struct("<BQQ")
+
+_TAG_BEACON = 0xB7          # reuses the Result tag: duplicate-tag
+_BIN_BEACON = struct.Struct("<BQQQ")
+
+_TAG_ASSIGN_ROLL = 0xB9     # same calcsize as _BIN_BEACON: length-collision
+_BIN_ASSIGN_ROLL = struct.Struct("<BQQII")
+
+
+def encode_roll(job_id: int, extranonce0: int) -> bytes:
+    # u64 fields packed with no _U64 range guard, no CRC trailer
+    return _BIN_ASSIGN_ROLL.pack(
+        _TAG_ASSIGN_ROLL, job_id, extranonce0, 1, 0
+    )
+
+
+def encode_beacon(job_id: int, high_water: int) -> bytes:
+    return _BIN_BEACON.pack(_TAG_BEACON, job_id, high_water, 0)

@@ -155,6 +155,53 @@ def test_codec_conformance_catches_bad_table():
     )
 
 
+def test_codec_conformance_catches_bad_roll_dialect_table():
+    """The ISSUE 14 bug class: a careless RollAssign/Beacon port that
+    reuses the Result tag (a beacon would decode as a full-chunk settle
+    — silent over-settling), collides on packed length, skips the CRC
+    trailer, and packs u64 fields unguarded must fail lint."""
+    findings = _fixture_findings("roll_dialect_bad.py", ["codec-conformance"])
+    violations = {f.symbol.split(":", 1)[0] for f in findings if ":" in f.symbol}
+    assert "duplicate-tag" in violations
+    assert "length-collision" in violations
+    assert "missing-crc" in violations
+    assert any(
+        f.symbol.startswith("duplicate-tag:")
+        and "_BIN_BEACON" in f.message
+        for f in findings
+    )
+    assert any(
+        f.symbol == "length-collision:_BIN_ASSIGN_ROLL" for f in findings
+    )
+    assert any(
+        f.qualname == "encode_roll" and f.symbol == "_BIN_ASSIGN_ROLL"
+        for f in findings
+    )
+
+
+def test_codec_conformance_covers_the_live_roll_dialect():
+    """The shipped 0xB9/0xBA kinds are under the checker's eye — parsed
+    out of tpuminter/protocol.py with the right tags, distinct packed
+    lengths, and the CRC seal — so a future regression to any of them
+    fails lint rather than relying on this test suite alone."""
+    from tpuminter.analysis.codec_conformance import (
+        check_table,
+        extract_kinds,
+        struct_size,
+    )
+
+    src = parse_module(REPO_ROOT, os.path.join("tpuminter", "protocol.py"))
+    kinds = {k["name"]: k for k in extract_kinds(src)}
+    roll = kinds["_BIN_ASSIGN_ROLL"]
+    beacon = kinds["_BIN_BEACON"]
+    assert roll["tag"] == 0xB9 and beacon["tag"] == 0xBA
+    assert roll["has_crc"] and beacon["has_crc"]
+    # 29- and 65-byte bodies (33/69 with the CRC trailer on the wire)
+    assert struct_size(roll["fmt"]) == 29
+    assert struct_size(beacon["fmt"]) == 65
+    assert check_table(list(kinds.values())) == []
+
+
 def test_bounded_state_catches_unbounded_table():
     findings = _fixture_findings("unbounded_table.py", ["bounded-state"])
     symbols = {f.symbol for f in findings}

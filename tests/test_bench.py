@@ -39,3 +39,21 @@ def test_bench_smoke_emits_one_json_line():
         obj["extra"]["rolled_dispatches_per_segment_batched_nb8"]
         < obj["extra"]["rolled_dispatches_per_segment_segmented_nb8"]
     )
+    # the roll-budget control-plane A/B rides every capture too
+    # (ISSUE 14): both arms measured at both nonce_bits points, every
+    # rolled_check gate held, and the production-shape collapse at or
+    # beyond the 1000x acceptance bar
+    for nb in (20, 32):
+        assert (
+            obj["extra"][f"rolled_cp_msgs_per_segment_budget_nb{nb}"]
+            < obj["extra"][f"rolled_cp_msgs_per_segment_classic_nb{nb}"]
+        )
+        assert (
+            obj["extra"][f"rolled_cp_bytes_per_segment_budget_nb{nb}"]
+            < obj["extra"][f"rolled_cp_bytes_per_segment_classic_nb{nb}"]
+        )
+        assert obj["extra"][f"rolled_cp_violations_nb{nb}"] == 0
+        assert (
+            obj["extra"][f"rolled_cp_beacon_overhead_pct_nb{nb}"] <= 5.0
+        )
+    assert obj["extra"]["rolled_cp_collapse_ratio_msgs_nb32"] >= 1000.0

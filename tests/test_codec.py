@@ -15,12 +15,14 @@ from tpuminter.journal import decode_settle, encode_settle
 from tpuminter.protocol import (
     MIN_UNTRACKED,
     Assign,
+    Beacon,
     Cancel,
     Join,
     PowMode,
     ProtocolError,
     Refuse,
     Result,
+    RollAssign,
     Setup,
     Request,
     WalBatch,
@@ -91,6 +93,23 @@ GOLDEN = [
     (
         WalBatch(offset=2**64 - 1, data=b""),
         struct.pack("<BQ", 0xB8, 2**64 - 1),
+    ),
+    # roll dialect (ISSUE 14): tags 0xB9/0xBA and the Join roll flag.
+    # Riding in GOLDEN puts both new kinds under the same exhaustive
+    # corruption/truncation sweeps as the v1 tags.
+    (
+        RollAssign(job_id=3, chunk_id=7, extranonce0=5, count=16),
+        struct.pack("<BQQQI", 0xB9, 3, 7, 5, 16),
+    ),
+    (
+        Beacon(job_id=3, chunk_id=7, high_water=(5 << 32) | 99,
+               nonce=(5 << 32) | 42, hash_value=0xFEED),
+        struct.pack("<BQQQQ32s", 0xBA, 3, 7, (5 << 32) | 99,
+                    (5 << 32) | 42, (0xFEED).to_bytes(32, "little")),
+    ),
+    (
+        Join(backend="cpu", codec="bin", roll=True),  # flags 0x01 | 0x02
+        struct.pack("<BBIQ16s", 0xB5, 3, 1, 0, b"cpu"),
     ),
 ]
 
@@ -339,3 +358,81 @@ def test_rolled_assign_wire_shape_baseline():
     # the per-job template cost amortizes: 100 chunks of a rolled job
     # cost one Setup + 100 fixed Assigns, not 100 template re-sends
     assert len(setup) + 100 * len(raw) < 100 * len(setup) // 10
+
+
+# ---------------------------------------------------------------------------
+# roll dialect (ISSUE 14): tags 0xB9/0xBA, the Join roll flag, and the
+# guards that keep a bad count off the wire
+# ---------------------------------------------------------------------------
+
+
+def test_roll_dialect_lengths_are_distinct():
+    """ALL fixed-width binary kinds — v1 plus the roll dialect — keep
+    unique total lengths, so a corrupted tag can never alias another
+    kind even before the CRC check."""
+    fixed = [
+        Assign(1, 2, 3, 4),
+        Result(1, PowMode.TARGET, 2, 3),
+        Refuse(1, 2),
+        Cancel(1),
+        Join(codec="bin"),
+        RollAssign(1, 2, 3, 4),
+        Beacon(1, 2, 3, 4, 5),
+    ]
+    lengths = [len(encode_msg(m, binary=True)) for m in fixed]
+    assert len(set(lengths)) == len(lengths), lengths
+
+
+def test_roll_dialect_cross_codec_agreement():
+    """RollAssign/Beacon mean the same thing from either codec, and a
+    rolled Join's advertisement survives both codecs — the mixed-fleet
+    invariant extends to the new dialect."""
+    rng = random.Random(0xB9BA)
+    for _ in range(100):
+        for msg in (
+            RollAssign(
+                rng.randrange(2**64), rng.randrange(2**64),
+                rng.randrange(2**64), rng.randrange(1, 2**32),
+            ),
+            Beacon(
+                rng.randrange(2**64), rng.randrange(2**64),
+                rng.randrange(2**64), rng.randrange(2**64),
+                rng.randrange(2**256),
+            ),
+            Join(backend="cpu", codec=rng.choice(["json", "bin"]),
+                 roll=rng.random() < 0.5),
+        ):
+            b = encode_msg(msg, binary=True)
+            j = encode_msg(msg)
+            assert payload_is_binary(b), msg
+            assert decode_msg(b) == msg, msg
+            assert decode_msg(j) == msg, msg
+
+
+def test_join_roll_flag_is_invisible_when_off():
+    """A non-rolling Join encodes to EXACTLY the pre-dialect bytes in
+    both codecs (the golden Join vectors above already pin binary):
+    old decoders see nothing new, which is what makes the roll
+    advertisement deployable with no flag day."""
+    import json as _json
+
+    off = _json.loads(encode_msg(Join(backend="cpu")))
+    assert "roll" not in off
+    on = _json.loads(encode_msg(Join(backend="cpu", roll=True)))
+    assert on["roll"] == 1
+
+
+def test_roll_assign_count_guards():
+    """count=0 (an empty sweep) and count >= 2^32 (wider than the
+    binary field) cannot be REPRESENTED in binary — encode falls back
+    to JSON like every unrepresentable message — and NO decoder, JSON
+    or hand-crafted binary, accepts a count below 1."""
+    assert not payload_is_binary(encode_msg(RollAssign(1, 2, 3, 0),
+                                            binary=True))
+    assert not payload_is_binary(encode_msg(RollAssign(1, 2, 3, 1 << 32),
+                                            binary=True))
+    with pytest.raises(ProtocolError):
+        decode_msg(encode_msg(RollAssign(1, 2, 3, 0)))
+    body = struct.pack("<BQQQI", 0xB9, 1, 2, 3, 0)
+    with pytest.raises(ProtocolError):
+        decode_msg(body + _crc(body))

@@ -296,6 +296,29 @@ def test_rolled_segments_tile_the_range_exactly(nonce_bits, en_lo, en_span, data
     assert expect == upper + 1
 
 
+@given(
+    nonce_bits=st.integers(1, 32),
+    e0=st.integers(0, 10**6),
+    count=st.integers(1, 4096),
+)
+def test_roll_span_is_exactly_count_whole_segments(nonce_bits, e0, count):
+    """The RollAssign expansion (ISSUE 14): ``roll_span(e0, count)`` is
+    exactly ``count`` WHOLE extranonce segments — aligned at both ends
+    and tiled by ``rolled_segments`` with full nonce sweeps. The
+    coordinator's carve and the worker's expansion share this one
+    function; any disagreement double-counts the range ledger.
+    (tests/test_roll_budget.py carries a deterministic seeded mirror,
+    since this image lacks hypothesis.)"""
+    lower, upper = chain.roll_span(e0, count, nonce_bits)
+    mask = (1 << nonce_bits) - 1
+    assert lower == e0 << nonce_bits
+    assert lower & mask == 0 and (upper + 1) & mask == 0
+    assert upper - lower + 1 == count << nonce_bits
+    segs = list(chain.rolled_segments(lower, upper, nonce_bits))
+    assert [en for en, _, _, _ in segs] == list(range(e0, e0 + count))
+    assert all(n_lo == 0 and n_hi == mask for _, _, n_lo, n_hi in segs)
+
+
 # ---------------------------------------------------------------------------
 # app-protocol codec
 # ---------------------------------------------------------------------------
@@ -542,6 +565,63 @@ def test_journal_double_replay_idempotent(records):
     assert _state_key(replay(records)) == _state_key(
         replay(records + records)
     )
+
+
+@settings(max_examples=60, deadline=None)
+@given(
+    nonce_bits=st.integers(2, 10),
+    segs=st.integers(1, 8),
+    data=st.data(),
+)
+def test_beacon_partial_settles_subtract_exactly(nonce_bits, segs, data):
+    """Beacon recovery (ISSUE 14): sub-chunk progress beacons journal as
+    ordinary settle records over a PREFIX of an in-flight chunk — zero
+    journal-format change — so replaying any mix of beacon prefixes and
+    whole-chunk settles must leave exactly the set-model's un-settled
+    indices remaining, with ``hashes_done`` matching the covered count.
+    (tests/test_roll_budget.py carries a deterministic seeded mirror,
+    since this image lacks hypothesis.)"""
+    from tpuminter.journal import merge_ranges
+    from tpuminter.protocol import PowMode as _PM, Request as _Req
+
+    total = segs << nonce_bits
+    req = _Req(
+        job_id=1, mode=_PM.TARGET, lower=0, upper=total - 1,
+        header=_GENESIS80, target=1, coinbase_prefix=b"p",
+        coinbase_suffix=b"s", extranonce_size=4, nonce_bits=nonce_bits,
+    )
+    records = [{"k": "job", "id": 1, "req": request_to_obj(req)}]
+    covered = set()
+    cuts = sorted(data.draw(st.sets(st.integers(1, total - 1), max_size=4)))
+    for lo, hi in zip([0] + cuts, [c - 1 for c in cuts] + [total - 1]):
+        for _ in range(data.draw(st.integers(0, 2))):
+            if lo > hi - 1:
+                break
+            hw = data.draw(st.integers(lo, hi - 1))
+            records.append({
+                "k": "settle", "id": 1, "lo": lo, "hi": hw,
+                "n": lo, "s": hw - lo + 1, "h": "ff",
+            })
+            covered.update(range(lo, hw + 1))
+            lo = hw + 1  # the live chunk advances past the beacon
+        if data.draw(st.booleans()) and lo <= hi:
+            records.append({
+                "k": "settle", "id": 1, "lo": lo, "hi": hi,
+                "n": lo, "s": hi - lo + 1, "h": "ff",
+            })
+            covered.update(range(lo, hi + 1))
+    state = replay(records)
+    want, g = [], 0
+    while g < total:
+        if g in covered:
+            g += 1
+            continue
+        start = g
+        while g < total and g not in covered:
+            g += 1
+        want.append((start, g - 1))
+    assert merge_ranges(state.jobs[1].remaining) == want
+    assert state.jobs[1].hashes_done == len(covered)
 
 
 # ---------------------------------------------------------------------------

@@ -44,6 +44,7 @@ __all__ = [
     "CoinbaseTemplate",
     "rolled_header",
     "split_global",
+    "roll_span",
     "rolled_segments",
     "rolled_tiles",
     "HEADER_SIZE",
@@ -409,6 +410,21 @@ def split_global(index: int, nonce_bits: int = 32) -> Tuple[int, int]:
     a tractable sweep.
     """
     return index >> nonce_bits, index & ((1 << nonce_bits) - 1)
+
+
+def roll_span(
+    extranonce0: int, count: int, nonce_bits: int = 32
+) -> Tuple[int, int]:
+    """Inclusive global-index range a roll-budget assign covers: ``count``
+    whole extranonce segments starting at ``extranonce0``, each spanning
+    the full ``2^nonce_bits`` header-nonce space. The single source of
+    the RollAssign → ``[lower, upper]`` expansion — coordinator carving
+    and worker expansion must agree on it bit-for-bit, or the exactly-
+    once range ledger double-counts."""
+    if count < 1:
+        raise ValueError("roll_span needs count >= 1")
+    lower = extranonce0 << nonce_bits
+    return lower, ((extranonce0 + count) << nonce_bits) - 1
 
 
 def rolled_segments(

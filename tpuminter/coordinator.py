@@ -56,6 +56,7 @@ from tpuminter.lsp.params import FAST
 from tpuminter.protocol import (
     MIN_UNTRACKED,
     Assign,
+    Beacon,
     Cancel,
     Join,
     PowMode,
@@ -64,6 +65,7 @@ from tpuminter.protocol import (
     RepHello,
     Request,
     Result,
+    RollAssign,
     Setup,
     decode_msg,
     encode_msg,
@@ -203,6 +205,11 @@ class _MinerState:
     #: coordinator has it enabled: Assign/Cancel to this miner go
     #: struct-packed; Setup stays JSON (the ragged long tail)
     binary: bool = False
+    #: peer advertised the roll-budget dialect (Join.roll): rolled
+    #: chunks to this miner may go as extranonce-unit RollAssigns and
+    #: it reports sub-chunk progress Beacons (ISSUE 14). Old peers
+    #: never see either — no flag day, same discipline as ``binary``.
+    roll: bool = False
     #: outstanding dispatches, oldest first:
     #: chunk_id → (job_id, lower, upper, dispatched_at). The chunk_id
     #: lets a Result be matched to the exact dispatch it answers: after
@@ -370,9 +377,25 @@ class Coordinator:
         winners_cap: int = WINNERS_CAP,
         winners_ttl: float = 0.0,
         unbound_ttl: float = 0.0,
+        roll_budget: int = 0,
     ):
         self._server = server
         self._chunk_size = chunk_size
+        # -- roll-budget chunking (ISSUE 14) --------------------------
+        if roll_budget < 0 or roll_budget > 0xFFFFFFFF:
+            raise ValueError("roll_budget must be in [0, 2^32-1]")
+        #: extranonce segments per rolled dispatch to a roll-dialect
+        #: worker (RollAssign); 0 disables it (the default and the A/B
+        #: baseline: rolled chunks go as global-index Assigns). At
+        #: nonce_bits=32 each unit of budget covers 2^32 nonces, so
+        #: even budget 1 collapses the per-job control-message count by
+        #: chunk_size×lanes / 2^32 versus index carving.
+        self._roll_budget = roll_budget
+        #: chunk_id → global indices already settled by accepted
+        #: Beacons, so the chunk's final Result.searched is not
+        #: double-counted (``_accept_result`` subtracts). Popped on
+        #: every path a chunk leaves the books by.
+        self._beacon_settled: Dict[int, int] = {}
         # -- admission & fairness (ISSUE 13) --------------------------
         if quota_rate < 0 or quota_burst < 1:
             raise ValueError("quota_rate must be >= 0, quota_burst >= 1")
@@ -578,6 +601,12 @@ class Coordinator:
             "winners_high_water": 0,
             "sessions_high_water": 0,
             "quota_buckets_high_water": 0,
+            #: roll-budget chunking (ISSUE 14): dispatches that went as
+            #: extranonce-unit RollAssigns (the control-plane collapse
+            #: loadgen's rolled scenario gates on) and sub-chunk
+            #: progress Beacons booked as partial settles
+            "chunks_roll_dispatched": 0,
+            "beacons_accepted": 0,
         }
         # TPUMINTER_LOOP_AFFINITY=1: the coordinator is single-loop by
         # contract (one per shard in multiloop); any mutation arriving
@@ -612,6 +641,7 @@ class Coordinator:
         winners_cap: int = WINNERS_CAP,
         winners_ttl: float = 0.0,
         unbound_ttl: float = 0.0,
+        roll_budget: int = 0,
     ) -> "Coordinator":
         """``recover_from`` names a write-ahead journal file
         (``tpuminter.journal``): if it exists its records are replayed —
@@ -645,6 +675,7 @@ class Coordinator:
             quota_tiers=quota_tiers, max_jobs=max_jobs,
             retry_after_ms=retry_after_ms, winners_cap=winners_cap,
             winners_ttl=winners_ttl, unbound_ttl=unbound_ttl,
+            roll_budget=roll_budget,
         )
         if recovered is not None:
             coord._adopt(recovered)
@@ -922,6 +953,8 @@ class Coordinator:
         # dispatch order mirrors steady-state frequency: Results dominate
         if isinstance(msg, Result):
             self._on_result(conn_id, msg)
+        elif isinstance(msg, Beacon):
+            self._on_beacon(conn_id, msg)
         elif isinstance(msg, Refuse):
             self._on_refuse(conn_id, msg)
         elif isinstance(msg, Join):
@@ -1120,13 +1153,18 @@ class Coordinator:
             # advertised it decodes binary; our first binary Assign is
             # what flips ITS send side in turn
             binary=self._binary_codec and msg.codec == "bin",
+            # roll-dialect negotiation mirrors the codec's: only a peer
+            # that advertised it ever receives a RollAssign (and only
+            # RollAssign recipients emit Beacons — worker side)
+            roll=msg.roll,
         )
         self._miners[conn_id] = miner
         self._idle[conn_id] = miner
         log.info(
-            "miner %d joined (backend=%s, lanes=%d, span=%d, codec=%s)",
+            "miner %d joined (backend=%s, lanes=%d, span=%d, codec=%s%s)",
             conn_id, msg.backend, msg.lanes, msg.span,
             "bin" if miner.binary else "json",
+            ", roll" if miner.roll else "",
         )
         self._schedule_dispatch()
 
@@ -1139,6 +1177,7 @@ class Coordinator:
         audit queue. The caller has already removed it from
         ``miner.chunks``."""
         job_id, lo, hi, _at = entry
+        self._beacon_settled.pop(chunk_id, None)
         audit = self._audits.pop(chunk_id, None)
         if audit is not None:
             self._audit_queue.append(audit)  # retry on another worker
@@ -1512,6 +1551,72 @@ class Coordinator:
                 self._reject_result(conn_id, job, msg, lo, hi)
         self._schedule_dispatch()
 
+    def _on_beacon(self, conn_id: int, msg: Beacon) -> None:
+        """Book a sub-chunk progress Beacon as a PARTIAL settle
+        (ISSUE 14): the worker claims every global index in
+        ``[chunk_lo, high_water]`` is verifiably swept winner-free, with
+        (nonce, hash) its running min over the chunk. On accept, the
+        prefix is journaled as an ordinary settle record — interval
+        subtraction in the journal replay means a crash re-mines only
+        the un-settled remainder — and the chunk's live bookkeeping
+        advances in place to ``[high_water + 1, hi]``, so hedging's age
+        clock and any requeue see real progress, not a stale dispatch.
+
+        Beacons never finish a job: a winner always arrives as the
+        chunk's final Result (a rolled search that found one stops
+        beaconing — the settled-prefix claim is only sound winner-free).
+        The claimed pair is host-verified like any Result, so a forged
+        min cannot poison the fold; a forged high_water is the same
+        residual under-search hole chunk Results have, closed by the
+        same sampled audits of the final Result."""
+        miner = self._miners.get(conn_id)
+        if miner is None:
+            return
+        entry = miner.chunks.get(msg.chunk_id)
+        if entry is None or msg.chunk_id in self._audits:
+            return  # stale (chunk settled/cancelled) or an audit
+        job_id, lo, hi, _at = entry
+        job = self._jobs.get(job_id)
+        if (
+            job is None or job.done or not job.request.rolled
+            or job.request.mode == PowMode.SCRYPT
+        ):
+            # only rolled fast-dialect chunks beacon; anything else is a
+            # confused or malicious peer (and a scrypt verify must never
+            # run inline on the loop)
+            return
+        hw = msg.high_water
+        if not lo <= hw < hi:
+            # below lo: already settled by an earlier beacon (dup/
+            # reorder). At hi: the final Result is imminent — let it
+            # settle the chunk with full accounting instead.
+            return
+        claim = Result(
+            job_id, job.request.mode, msg.nonce, msg.hash_value,
+            found=False, chunk_id=msg.chunk_id,
+        )
+        if not self._verify_result(job.request, claim):
+            log.warning(
+                "miner %d sent an unverifiable beacon for job %d "
+                "(nonce=%d); ignored", conn_id, job_id, msg.nonce,
+            )
+            return
+        searched = hw - lo + 1
+        job.hashes_done += searched
+        self.stats["hashes"] += searched
+        self.stats["beacons_accepted"] += 1
+        miner.hashes += searched
+        job.fold(msg.hash_value, msg.nonce)
+        self._journal_settle(job, lo, hw, claim, searched)
+        # advance IN PLACE: the same chunk_id now covers the residual
+        # range, and the refreshed dispatch stamp tells the hedger this
+        # worker is progressing (a beaconing straggler isn't straggling)
+        miner.chunks[msg.chunk_id] = (job_id, hw + 1, hi, time.monotonic())
+        job.inflight[msg.chunk_id] = (conn_id, hw + 1, hi)
+        self._beacon_settled[msg.chunk_id] = (
+            self._beacon_settled.get(msg.chunk_id, 0) + searched
+        )
+
     async def _settle_offloaded(
         self, conn_id: int, job_id: int, lo: int, hi: int,
         dispatched_at: float, msg: Result,
@@ -1592,7 +1697,16 @@ class Coordinator:
         """Book a verified chunk Result: accounting, hedge settlement,
         fold, and job completion (shared by the inline and offloaded
         verification paths)."""
-        searched = msg.searched if msg.searched > 0 else hi - lo + 1
+        # beacon reconciliation (ISSUE 14): the worker's final
+        # Result.searched covers the WHOLE original chunk, but accepted
+        # Beacons already booked a settled prefix (and advanced lo past
+        # it) — subtract so nothing double-counts. A zero-searched
+        # (sentinel-accounting) Result books the residual range.
+        settled = self._beacon_settled.pop(msg.chunk_id, 0)
+        searched = (
+            max(0, msg.searched - settled) if msg.searched > 0
+            else hi - lo + 1
+        )
         job.hashes_done += searched
         self.stats["hashes"] += searched
         self.stats["results_accepted"] += 1
@@ -1627,6 +1741,9 @@ class Coordinator:
             "(nonce=%d); chunk [%d, %d] requeued",
             conn_id, job.job_id, msg.nonce, lo, hi,
         )
+        # beacon-settled prefixes stay settled (each was independently
+        # verified and journaled); only the residual [lo, hi] re-mines
+        self._beacon_settled.pop(msg.chunk_id, None)
         self.stats["results_rejected"] += 1
         self._requeue_chunk(job, lo, hi)
         miner = self._miners.get(conn_id)
@@ -1717,12 +1834,15 @@ class Coordinator:
         job.pending_audits += 1
 
     def _write_dispatch(
-        self, miner: _MinerState, job: _Job, chunk_id: int, lo: int, hi: int
+        self, miner: _MinerState, job: _Job, chunk_id: int, lo: int, hi: int,
+        roll: Optional[Tuple[int, int]] = None,
     ) -> None:
         """The one place dispatch framing lives (normal chunks and
         audits alike): ship the job template once per worker (Setup),
-        then the range (Assign). Raises ConnectionError on a dead conn;
-        the caller rolls back its own bookkeeping."""
+        then the range (Assign), or — for a roll-budget carve — the
+        extranonce-unit RollAssign the range expands from. Raises
+        ConnectionError on a dead conn; the caller rolls back its own
+        bookkeeping."""
         if miner.conn_id not in job.setup_sent:
             # LSP's ordered delivery guarantees the worker caches the
             # Setup before any Assign referencing it arrives. Setup
@@ -1733,11 +1853,13 @@ class Coordinator:
                 encode_msg(Setup(dc_replace(job.request, job_id=job.job_id))),
             )
             job.setup_sent.add(miner.conn_id)
+        if roll is not None:
+            e0, count = roll
+            out = RollAssign(job.job_id, chunk_id, e0, count)
+        else:
+            out = Assign(job.job_id, chunk_id, lo, hi)
         self._server.write(
-            miner.conn_id,
-            encode_msg(
-                Assign(job.job_id, chunk_id, lo, hi), binary=miner.binary
-            ),
+            miner.conn_id, encode_msg(out, binary=miner.binary)
         )
 
     def _assign_audit(self, miner: _MinerState, job: _Job, audit: _Audit) -> bool:
@@ -1861,9 +1983,14 @@ class Coordinator:
 
     def _requeue_chunk(self, job: _Job, lo: int, hi: int) -> None:
         """Return a chunk to the front of its job's queue (the shared
-        path for miner death and rejected results)."""
+        path for miner death and rejected results). Live-copy matching
+        is keyed (job_id, hi): a chunk's hi is immutable and unique
+        among a job's disjoint live ranges, while its lo advances under
+        accepted Beacons — an exact-triple match would miss a hedge
+        copy whose prefix settled."""
         if any(
-            entry[:3] == (job.job_id, lo, hi) and cid not in self._audits
+            entry[0] == job.job_id and entry[2] == hi
+            and cid not in self._audits
             for m in self._miners.values()
             for cid, entry in m.chunks.items()
         ):
@@ -2083,6 +2210,7 @@ class Coordinator:
         cancelled: set = set()
         for chunk_id, (miner_conn, _lo, _hi) in list(job.inflight.items()):
             job.inflight.pop(chunk_id, None)
+            self._beacon_settled.pop(chunk_id, None)
             miner = self._miners.get(miner_conn)
             if miner is not None and miner.chunks.pop(chunk_id, None) is not None:
                 self._mark_idle(miner)
@@ -2162,11 +2290,17 @@ class Coordinator:
                 continue
             miner = idle.popleft()
             lo, hi = job.ranges.popleft()
-            take = min(hi - lo + 1, self._budget(miner, job))
-            chunk_hi = lo + take - 1
+            roll = self._roll_carve(miner, job, lo, hi)
+            if roll is not None:
+                chunk_hi = chain.roll_span(
+                    roll[0], roll[1], job.request.nonce_bits
+                )[1]
+            else:
+                take = min(hi - lo + 1, self._budget(miner, job))
+                chunk_hi = lo + take - 1
             if chunk_hi < hi:
                 job.ranges.appendleft((chunk_hi + 1, hi))
-            if not self._assign(miner, job, lo, chunk_hi):
+            if not self._assign(miner, job, lo, chunk_hi, roll=roll):
                 job.ranges.appendleft((lo, chunk_hi))
                 failed.append(miner)
                 continue
@@ -2183,6 +2317,34 @@ class Coordinator:
             self._mark_idle(m)
         for m in failed:
             self._mark_idle(m)
+
+    def _roll_carve(
+        self, miner: _MinerState, job: _Job, lo: int, hi: int
+    ) -> Optional[Tuple[int, int]]:
+        """Extranonce-unit carve for a rolled job (ISSUE 14): return
+        ``(extranonce0, count)`` when this dispatch can go as ONE
+        RollAssign covering ``count`` whole segments, else None (the
+        classic global-index budget applies). Requires the dialect on
+        both ends, an opted-in budget, and a segment-aligned range —
+        a requeued mid-segment remainder (beacon-advanced lo, or a
+        half-job split) always falls back to an exact Assign, so
+        coverage arithmetic never rounds."""
+        if self._roll_budget <= 0 or not miner.roll:
+            return None
+        req = job.request
+        if not req.rolled or req.mode == PowMode.SCRYPT:
+            return None
+        nb = req.nonce_bits
+        if lo & ((1 << nb) - 1):
+            return None  # mid-segment lo: only exact ranges are sound
+        whole = (hi - lo + 1) >> nb
+        if whole < 1:
+            return None  # sub-segment tail: classic Assign
+        # same anti-monopoly intent as _budget's half-job cap, in
+        # segment units (floored at 1: a one-segment job is one carve)
+        cap = max(1, ((req.upper - req.lower + 2) // 2) >> nb)
+        count = min(self._roll_budget, whole, cap, 0xFFFFFFFF)
+        return lo >> nb, count
 
     def _budget(self, miner: _MinerState, job: _Job) -> int:
         """Per-dispatch nonce budget for this (miner, dialect) pair."""
@@ -2213,9 +2375,17 @@ class Coordinator:
                 budget -= budget % miner.span
         return budget
 
-    def _assign(self, miner: _MinerState, job: _Job, lo: int, hi: int) -> bool:
+    def _assign(
+        self, miner: _MinerState, job: _Job, lo: int, hi: int,
+        roll: Optional[Tuple[int, int]] = None,
+    ) -> bool:
         """Book-keep + write one chunk dispatch; False if the write
-        failed (caller decides what to do with the range)."""
+        failed (caller decides what to do with the range). ``roll`` is
+        an ``(extranonce0, count)`` carve from :meth:`_roll_carve` —
+        the wire message compresses to a RollAssign, but ALL
+        bookkeeping stays in global indices (``[lo, hi]`` must equal
+        ``chain.roll_span``'s expansion), so journaling, recovery,
+        requeue and hedging are dialect-blind."""
         chunk_id = self._next_chunk_id
         self._next_chunk_id += 1
         pipelined = miner.busy
@@ -2224,7 +2394,7 @@ class Coordinator:
             self._idle.pop(miner.conn_id, None)
         job.inflight[chunk_id] = (miner.conn_id, lo, hi)
         try:
-            self._write_dispatch(miner, job, chunk_id, lo, hi)
+            self._write_dispatch(miner, job, chunk_id, lo, hi, roll=roll)
         except ConnectionError:
             # lost between our bookkeeping and the write; undo
             miner.chunks.pop(chunk_id, None)
@@ -2232,6 +2402,8 @@ class Coordinator:
             return False
         if pipelined:
             self.stats["dispatches_pipelined"] += 1
+        if roll is not None:
+            self.stats["chunks_roll_dispatched"] += 1
         if self._journal_assigns:
             self._journal_append("assign", {
                 "id": job.job_id, "c": chunk_id, "lo": lo, "hi": hi,
@@ -2248,12 +2420,17 @@ class Coordinator:
         is untouched — only duplicated work is spent, which is exactly
         what idle capacity is."""
         now = time.monotonic()
-        # ranges already dispatched to 2+ miners need no further hedging
-        seen: Dict[Tuple[int, int, int], int] = {}
+        # ranges already dispatched to 2+ miners need no further
+        # hedging. Copies are identified by (job_id, hi): hi is
+        # immutable while a Beacon-advanced copy's lo has moved — and
+        # the hedge dispatched below uses the CURRENT lo, so a backup
+        # of a beaconing-but-slow worker re-mines only the un-settled
+        # residual, not ground the beacons already journaled.
+        seen: Dict[Tuple[int, int], int] = {}
         for m in self._miners.values():
             for cid, (job_id, lo, hi, _at) in m.chunks.items():
                 if cid not in self._audits:
-                    seen[(job_id, lo, hi)] = seen.get((job_id, lo, hi), 0) + 1
+                    seen[(job_id, hi)] = seen.get((job_id, hi), 0) + 1
         candidates = sorted(
             (
                 (at, m.conn_id, job_id, lo, hi)
@@ -2261,7 +2438,7 @@ class Coordinator:
                 for cid, (job_id, lo, hi, at) in m.chunks.items()
                 if cid not in self._audits  # audits aren't hedged
                 and now - at > self._hedge_after
-                and seen[(job_id, lo, hi)] == 1
+                and seen[(job_id, hi)] == 1
             ),
         )
         for at, straggler_conn, job_id, lo, hi in candidates:
@@ -2307,20 +2484,23 @@ class Coordinator:
         """A chunk Result was accepted: release any OTHER miner still
         mining the same range (a hedge loser). Its eventual Result
         fails the chunk-id match and is dropped, so nothing double
-        counts; the Cancel stops it burning device time."""
+        counts; the Cancel stops it burning device time. Copies match
+        on (job_id, hi) — the loser's lo may have Beacon-advanced past
+        the winner's original lower bound."""
         for m in self._miners.values():
             if m.conn_id == winner_conn:
                 continue
             hedged = [
                 cid for cid, entry in m.chunks.items()
                 if cid not in self._audits
-                and entry[:3] == (job.job_id, lo, hi)
+                and entry[0] == job.job_id and entry[2] == hi
             ]
             if not hedged:
                 continue
             for cid in hedged:
                 m.chunks.pop(cid, None)
                 job.inflight.pop(cid, None)
+                self._beacon_settled.pop(cid, None)
             # The Cancel below is JOB-scoped: the loser abandons
             # whatever chunk of this job it is currently mining
             # (sending nothing back) and Refuses any queued Assigns
@@ -2370,6 +2550,15 @@ def main(argv: Optional[list] = None) -> None:
         "re-mining a small random sub-range on a different worker; a "
         "provable under-search evicts the worker and requeues its chunk "
         "(off by default: audits duplicate a little work)",
+    )
+    parser.add_argument(
+        "--roll-budget", type=int, default=0, metavar="N",
+        help="dispatch rolled jobs to roll-dialect workers as "
+        "extranonce-unit RollAssigns of up to N whole segments (each "
+        "2^nonce_bits nonces) — one compact message where index "
+        "carving sends thousands — with sub-chunk progress Beacons "
+        "journaled as partial settles (0 = off, the global-index "
+        "baseline; README 'Roll-budget chunks')",
     )
     parser.add_argument(
         "--stats-port", type=int, default=None, metavar="PORT",
@@ -2537,6 +2726,7 @@ def main(argv: Optional[list] = None) -> None:
                 replicate_to=replicate_to,
                 replica_ack=args.replica_ack,
                 io_batch=args.io_batch == "on",
+                roll_budget=args.roll_budget,
                 **admission,
             )
             log.info(
@@ -2574,6 +2764,7 @@ def main(argv: Optional[list] = None) -> None:
             replicate_to=replicate_to,
             replica_ack=args.replica_ack,
             io_batch=args.io_batch == "on",
+            roll_budget=args.roll_budget,
             **admission,
         )
         log.info("coordinator listening on port %d", coord.port)

@@ -310,7 +310,7 @@ class JaxMiner(Miner):
 
             yield from rolled.mine_rolled_tracking(
                 req, width_cap=self.batch, depth=self.depth,
-                roll_batch=self.roll_batch,
+                roll_batch=self.roll_batch, progress=self.progress_cb,
             )
             return
         from tpuminter.ops import merkle
@@ -348,6 +348,12 @@ class JaxMiner(Miner):
                 )
                 if best is None or cand < best:
                     best = cand
+                if self.progress_cb is not None:
+                    # batches resolve in order: every index through this
+                    # batch's last valid nonce is settled, no winner
+                    self.progress_cb(
+                        (base_g | start) + valid - 1, best[1], best[0]
+                    )
                 yield None
         yield Result(
             req.job_id, req.mode, best[1], best[0],

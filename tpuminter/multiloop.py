@@ -472,6 +472,7 @@ class MultiLoopCoordinator:
         winners_cap: Optional[int] = None,
         winners_ttl: float = 0.0,
         unbound_ttl: float = 0.0,
+        roll_budget: int = 0,
     ) -> "MultiLoopCoordinator":
         if loops < 1:
             raise ValueError("loops must be >= 1")
@@ -567,6 +568,9 @@ class MultiLoopCoordinator:
             quota_rate=quota_rate, quota_burst=quota_burst,
             quota_tiers=quota_tiers, max_jobs=max_jobs,
             winners_ttl=winners_ttl, unbound_ttl=unbound_ttl,
+            # roll-budget carving (ISSUE 14) is shard-local like every
+            # other dispatch decision: a rolled job lives on one shard
+            roll_budget=roll_budget,
         )
         if retry_after_ms is not None:
             coord_kwargs["retry_after_ms"] = retry_after_ms

@@ -410,6 +410,7 @@ class PodMiner(Miner):
             domain=1 << rolled.span_bits(req),
         )
         for _ in search.events():
+            rolled.report_search_progress(search, req.lower, self.progress_cb)
             yield None
         yield self._fast_result(req, search)
 
@@ -470,6 +471,14 @@ class PodMiner(Miner):
                 )
                 return
             searched += out.searched
+            if self.progress_cb is not None and (base_g | n_hi) < req.upper:
+                # segment-boundary granularity: everything up to this
+                # segment's end is settled winner-free
+                bh, bg = min(
+                    ((h, g) for g, h in candidates),
+                    default=(MIN_UNTRACKED, req.lower),
+                )
+                self.progress_cb(base_g | n_hi, bg, bh)
         best = min(((h, g) for g, h in candidates), default=None)
         hash_value, nonce = best if best else (MIN_UNTRACKED, req.lower)
         yield Result(

@@ -56,6 +56,25 @@ after it has SEEN a binary payload from the coordinator (proof the peer
 decodes them). Either side being older than the other therefore
 degrades to JSON automatically, which the interop e2e pins
 (tests/test_e2e.py).
+
+**Roll-budget dialect (ISSUE 14).** For rolled jobs the natural unit of
+dispatch is the *extranonce*, not the global index: at production
+``nonce_bits=32`` a classic Assign covers a few thousand of the 2^32
+nonces under one extranonce, so control-plane messages per unit of work
+are ~4·10⁹× what they need to be. :class:`RollAssign` fixes that — it
+says "mine extranonces ``[extranonce0, extranonce0+count)``, full
+``2^nonce_bits`` nonces each" in one 33-byte message, and because one
+such chunk can represent hours of work, :class:`Beacon` lets the worker
+periodically report its settled global-index high-water (plus its
+running min-fold candidate) so the coordinator can journal partial
+settles, see real straggler progress, and re-mine only the un-settled
+sub-range after a crash. Negotiation mirrors codec v1 exactly: a worker
+advertises the dialect in its Join (``roll=True`` → JSON key
+``"roll": 1`` / binary flag bit 0x02 — both invisible to old decoders),
+the coordinator only sends RollAssign to workers that advertised it,
+and a worker only emits Beacons for chunks that ARRIVED as a RollAssign
+(proof the coordinator speaks the dialect). Either side being old
+degrades to classic global-index Assigns with no flag day.
 """
 
 from __future__ import annotations
@@ -75,6 +94,8 @@ __all__ = [
     "Cancel",
     "Setup",
     "Assign",
+    "RollAssign",
+    "Beacon",
     "Refuse",
     "RepHello",
     "SyncFrom",
@@ -138,12 +159,21 @@ class Join:
     an advertisement, not a demand: the coordinator still decodes both
     from everyone, and only starts ENCODING binary toward a worker that
     advertised it.
+
+    ``roll`` advertises the roll-budget dialect (module docstring): this
+    worker understands :class:`RollAssign` and can emit :class:`Beacon`
+    progress for such chunks. Same contract as ``codec``: an
+    advertisement an old coordinator never sees (the JSON key is omitted
+    when False and old decoders ignore it; the binary flag bit is one an
+    old decoder never tests), and the coordinator only dispatches
+    RollAssigns to workers that set it.
     """
 
     backend: str = "cpu"
     lanes: int = 1
     span: int = 0
     codec: str = "json"
+    roll: bool = False
 
 
 @dataclass(frozen=True)
@@ -279,6 +309,53 @@ class Assign:
 
 
 @dataclass(frozen=True)
+class RollAssign:
+    """Coordinator → worker: mine extranonces ``[extranonce0,
+    extranonce0 + count)`` of the rolled job whose template a prior
+    :class:`Setup` delivered — every one of them over the FULL
+    ``2^nonce_bits`` header-nonce sweep. Equivalent to an
+    :class:`Assign` of the global-index range ``[extranonce0 <<
+    nonce_bits, (extranonce0 + count) << nonce_bits - 1]`` (the worker
+    expands it exactly so, against the cached template's ``nonce_bits``),
+    but one 33-byte message now covers ``count · 2^nonce_bits`` indices
+    instead of a few thousand. Only sent to workers that advertised
+    ``Join.roll`` (module docstring); progress inside the chunk flows
+    back via :class:`Beacon`."""
+
+    job_id: int
+    chunk_id: int
+    extranonce0: int
+    count: int
+
+
+@dataclass(frozen=True)
+class Beacon:
+    """Worker → coordinator: sub-chunk progress on a roll-budget chunk.
+
+    ``high_water`` is the settled global-index high-water: every index
+    of the chunk up to and including it has been verifiably swept with
+    no winner found. ``nonce``/``hash_value`` carry the worker's running
+    min-fold over the searched prefix (same semantics as a Result's
+    argmin fields; :data:`MIN_UNTRACKED` when the fast path doesn't
+    track it), so the coordinator's min bookkeeping stays exact even if
+    the chunk later dies. The coordinator verifies the claimed pair like
+    a Result, journals ``[chunk_lower, high_water]`` as a PARTIAL settle
+    (ordinary settle record — interval subtraction in recovery already
+    handles sub-ranges), and advances the in-flight chunk's lower bound,
+    so crash recovery re-mines only the un-settled sub-range and
+    hedging/eviction sees real straggler progress instead of a silent
+    multi-hour chunk. Purely advisory: losing every Beacon degrades to
+    pre-beacon behavior, and the final Result still settles the whole
+    remainder."""
+
+    job_id: int
+    chunk_id: int
+    high_water: int
+    nonce: int
+    hash_value: int
+
+
+@dataclass(frozen=True)
 class Refuse:
     """Worker → coordinator: I cannot mine this dispatch (no cached
     template for its job). The recovery seam that keeps the template
@@ -381,8 +458,8 @@ class SyncAck:
 
 
 Message = Union[
-    Join, Request, Result, Cancel, Setup, Assign, Refuse,
-    RepHello, SyncFrom, WalStart, WalBatch, SyncAck,
+    Join, Request, Result, Cancel, Setup, Assign, RollAssign, Beacon,
+    Refuse, RepHello, SyncFrom, WalStart, WalBatch, SyncAck,
 ]
 
 _KINDS = {
@@ -392,6 +469,8 @@ _KINDS = {
     "cancel": Cancel,
     "setup": Setup,
     "assign": Assign,
+    "rassign": RollAssign,
+    "beacon": Beacon,
     "refuse": Refuse,
     "rhello": RepHello,
     "syncfrom": SyncFrom,
@@ -431,6 +510,12 @@ _TAG_REFUSE_WAIT = 0xB6
 #: a variable-length kind; the trailing CRC32 alone carries the
 #: corruption contract (any single-byte flip fails it).
 _TAG_WALBATCH = 0xB8
+#: Roll-budget dialect (module docstring): coordinator → worker
+#: extranonce-unit dispatch and worker → coordinator sub-chunk progress.
+#: New tags, not new layouts for 0xB1/0xB2 — v1 tags never change
+#: meaning, and an old peer fails the unknown-tag check loudly.
+_TAG_ASSIGN_ROLL = 0xB9
+_TAG_BEACON = 0xBA
 
 # Field layouts (little-endian). Every struct is a distinct total size
 # (+4 CRC bytes), so a corrupted tag always fails the length check even
@@ -445,6 +530,11 @@ _BIN_CANCEL = struct.Struct("<BQ")           # tag, job
 _BIN_JOIN = struct.Struct("<BBIQ16s")        # tag, flags, lanes, span,
 #                                              backend (NUL-padded utf8)
 _BIN_WALBATCH_HEAD = struct.Struct("<BQ")    # tag, offset (data follows)
+_BIN_ASSIGN_ROLL = struct.Struct("<BQQQI")   # tag, job, chunk,
+#                                              extranonce0, count
+_BIN_BEACON = struct.Struct("<BQQQQ32s")     # tag, job, chunk,
+#                                              high_water, nonce,
+#                                              hash (u256 LE)
 _CRC = struct.Struct("<I")
 
 _BIN_BY_TAG = {
@@ -454,9 +544,12 @@ _BIN_BY_TAG = {
     _TAG_REFUSE_WAIT: _BIN_REFUSE_WAIT,
     _TAG_CANCEL: _BIN_CANCEL,
     _TAG_JOIN: _BIN_JOIN,
+    _TAG_ASSIGN_ROLL: _BIN_ASSIGN_ROLL,
+    _TAG_BEACON: _BIN_BEACON,
 }
 
-_JOIN_FLAG_BIN = 0x01  # Join.codec == "bin"
+_JOIN_FLAG_BIN = 0x01   # Join.codec == "bin"
+_JOIN_FLAG_ROLL = 0x02  # Join.roll (roll-budget dialect capability)
 
 _MODE_TO_WIRE = {PowMode.MIN: 0, PowMode.TARGET: 1, PowMode.SCRYPT: 2}
 _MODE_FROM_WIRE = {v: k for k, v in _MODE_TO_WIRE.items()}
@@ -498,6 +591,24 @@ def _encode_binary(msg: Message) -> Optional[bytes]:
         return _seal(_BIN_ASSIGN.pack(
             _TAG_ASSIGN, msg.job_id, msg.chunk_id, msg.lower, msg.upper
         ))
+    if isinstance(msg, RollAssign):
+        if not (0 <= msg.job_id < _U64 and 0 <= msg.chunk_id < _U64
+                and 0 <= msg.extranonce0 < _U64
+                and 0 < msg.count < (1 << 32)):
+            return None
+        return _seal(_BIN_ASSIGN_ROLL.pack(
+            _TAG_ASSIGN_ROLL, msg.job_id, msg.chunk_id,
+            msg.extranonce0, msg.count,
+        ))
+    if isinstance(msg, Beacon):
+        if not (0 <= msg.job_id < _U64 and 0 <= msg.chunk_id < _U64
+                and 0 <= msg.high_water < _U64 and 0 <= msg.nonce < _U64
+                and 0 <= msg.hash_value < _U256):
+            return None
+        return _seal(_BIN_BEACON.pack(
+            _TAG_BEACON, msg.job_id, msg.chunk_id, msg.high_water,
+            msg.nonce, msg.hash_value.to_bytes(32, "little"),
+        ))
     if isinstance(msg, Result):
         if not (0 <= msg.job_id < _U64 and 0 <= msg.nonce < _U64
                 and 0 <= msg.hash_value < _U256
@@ -530,6 +641,8 @@ def _encode_binary(msg: Message) -> Optional[bytes]:
                 or msg.codec not in ("json", "bin")):
             return None
         flags = _JOIN_FLAG_BIN if msg.codec == "bin" else 0
+        if msg.roll:
+            flags |= _JOIN_FLAG_ROLL
         return _seal(_BIN_JOIN.pack(
             _TAG_JOIN, flags, msg.lanes, msg.span, backend
         ))
@@ -584,6 +697,21 @@ def _decode_binary(raw) -> Message:
         if tag == _TAG_ASSIGN:
             _, job_id, chunk_id, lower, upper = _BIN_ASSIGN.unpack_from(raw)
             return Assign(job_id, chunk_id, lower, upper)
+        if tag == _TAG_ASSIGN_ROLL:
+            _, job_id, chunk_id, extranonce0, count = (
+                _BIN_ASSIGN_ROLL.unpack_from(raw)
+            )
+            if count < 1:
+                raise ProtocolError("roll assign must cover >= 1 extranonce")
+            return RollAssign(job_id, chunk_id, extranonce0, count)
+        if tag == _TAG_BEACON:
+            _, job_id, chunk_id, high_water, nonce, digest = (
+                _BIN_BEACON.unpack_from(raw)
+            )
+            return Beacon(
+                job_id, chunk_id, high_water, nonce,
+                int.from_bytes(digest, "little"),
+            )
         if tag == _TAG_REFUSE:
             _, job_id, chunk_id = _BIN_REFUSE.unpack_from(raw)
             return Refuse(job_id, chunk_id)
@@ -598,6 +726,7 @@ def _decode_binary(raw) -> Message:
             backend=backend.rstrip(b"\x00").decode("utf-8"),
             lanes=lanes, span=span,
             codec="bin" if flags & _JOIN_FLAG_BIN else "json",
+            roll=bool(flags & _JOIN_FLAG_ROLL),
         )
     except (struct.error, UnicodeDecodeError) as exc:
         raise ProtocolError(f"malformed binary message: {exc}") from exc
@@ -677,6 +806,8 @@ def encode_msg(msg: Message, *, binary: bool = False) -> bytes:
                "span": msg.span}
         if msg.codec != "json":
             obj["codec"] = msg.codec
+        if msg.roll:
+            obj["roll"] = 1
     elif isinstance(msg, Request):
         obj = _request_obj(msg)
     elif isinstance(msg, Setup):
@@ -688,6 +819,23 @@ def encode_msg(msg: Message, *, binary: bool = False) -> bytes:
             "chunk_id": msg.chunk_id,
             "lower": msg.lower,
             "upper": msg.upper,
+        }
+    elif isinstance(msg, RollAssign):
+        obj = {
+            "kind": "rassign",
+            "job_id": msg.job_id,
+            "chunk_id": msg.chunk_id,
+            "e0": msg.extranonce0,
+            "count": msg.count,
+        }
+    elif isinstance(msg, Beacon):
+        obj = {
+            "kind": "beacon",
+            "job_id": msg.job_id,
+            "chunk_id": msg.chunk_id,
+            "hw": msg.high_water,
+            "nonce": msg.nonce,
+            "hash": f"{msg.hash_value:x}",
         }
     elif isinstance(msg, Refuse):
         obj = {"kind": "refuse", "job_id": msg.job_id, "chunk_id": msg.chunk_id}
@@ -756,6 +904,7 @@ def decode_msg(raw) -> Message:
                 lanes=int(obj.get("lanes", 1)),
                 span=int(obj.get("span", 0)),
                 codec=str(obj.get("codec", "json")),
+                roll=bool(obj.get("roll", 0)),
             )
         if kind == "request":
             return _request_from_obj(obj)
@@ -770,6 +919,24 @@ def decode_msg(raw) -> Message:
                 chunk_id=int(obj["chunk_id"]),
                 lower=int(obj["lower"]),
                 upper=int(obj["upper"]),
+            )
+        if kind == "rassign":
+            count = int(obj["count"])
+            if count < 1:
+                raise ProtocolError("roll assign must cover >= 1 extranonce")
+            return RollAssign(
+                job_id=int(obj["job_id"]),
+                chunk_id=int(obj["chunk_id"]),
+                extranonce0=int(obj["e0"]),
+                count=count,
+            )
+        if kind == "beacon":
+            return Beacon(
+                job_id=int(obj["job_id"]),
+                chunk_id=int(obj["chunk_id"]),
+                high_water=int(obj["hw"]),
+                nonce=int(obj["nonce"]),
+                hash_value=int(obj["hash"], 16),
             )
         if kind == "refuse":
             return Refuse(

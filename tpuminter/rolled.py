@@ -45,7 +45,7 @@ from __future__ import annotations
 
 import struct
 from functools import lru_cache, partial
-from typing import Dict, Iterator, NamedTuple, Optional, Tuple
+from typing import Callable, Dict, Iterator, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -64,9 +64,20 @@ __all__ = [
     "rolled_verifier",
     "mine_rolled_fast",
     "mine_rolled_tracking",
+    "ProgressFn",
+    "report_search_progress",
 ]
 
 _UMAX = np.uint32(0xFFFFFFFF)
+
+#: progress(high_water, best_nonce, best_hash): sub-chunk settled
+#: high-water reporting for roll-budget chunks (ISSUE 14). Called at
+#: window/segment boundaries from the mining (executor) thread with the
+#: highest verifiably-swept GLOBAL index and the running min-fold pair
+#: (``MIN_UNTRACKED`` when no candidate surfaced yet). The worker role
+#: loop installs one to feed Beacon emission; None (the default
+#: everywhere) keeps the paths bit-for-bit on their pre-beacon behavior.
+ProgressFn = Callable[[int, int, int], None]
 
 
 def span_bits(req: Request) -> int:
@@ -189,6 +200,26 @@ def rolled_verifier(req: Request):
     return verify
 
 
+def report_search_progress(search: CandidateSearch, fallback_nonce: int,
+                           progress: Optional[ProgressFn]) -> None:
+    """One :data:`ProgressFn` step for a running global-index
+    ``CandidateSearch``: report its settled high-water and running
+    min-fold. No-op while nothing is settled or once the search has an
+    outcome (a found outcome means a winner sits inside the would-be
+    prefix — the final Result covers it). Shared by every batched rolled
+    path (here and ``pod_worker``)."""
+    if progress is None or search.outcome is not None:
+        return
+    hw = search.settled_high_water()
+    if hw is None:
+        return
+    cand = search.best_candidate()
+    if cand is None:
+        progress(hw, fallback_nonce, MIN_UNTRACKED)
+    else:
+        progress(hw, cand[1], cand[0])
+
+
 def _resolve_engine(engine: str) -> str:
     if engine == "auto":
         return "jnp" if jax.default_backend() == "cpu" else "pallas"
@@ -307,6 +338,7 @@ def mine_rolled_fast(
     tiles_per_step: int = 8,
     cand_bits: int = 32,
     counters: Optional[Dict[str, int]] = None,
+    progress: Optional[ProgressFn] = None,
 ) -> Iterator[Optional[Result]]:
     """The production >2^32 search, batched: candidate sweeps over the
     whole rolled range through ONE ``CandidateSearch``, each dispatch
@@ -318,6 +350,8 @@ def mine_rolled_fast(
 
     ``counters`` (optional dict) accumulates ``rolls``/``sweeps`` —
     device dispatch evidence for bench.py's rolled A/B fields.
+    ``progress`` (:data:`ProgressFn`) receives the settled global-index
+    high-water after each resolved window — the roll-budget beacon feed.
     """
     assert req.rolled and req.header is not None and req.target is not None
     engine = _resolve_engine(engine)
@@ -329,7 +363,7 @@ def mine_rolled_fast(
         yield from _mine_rolled_fast_segmented(
             req, verify, hw1_cap, slab=slab, depth=depth, engine=engine,
             tiles_per_step=tiles_per_step, cand_bits=cand_bits,
-            counters=counters,
+            counters=counters, progress=progress,
         )
         return
 
@@ -367,6 +401,7 @@ def mine_rolled_fast(
         slab=window, depth=depth, domain=1 << span_bits(req),
     )
     for _ in search.events():
+        report_search_progress(search, req.lower, progress)
         yield None  # heartbeat / Cancel window per resolved window
     out = search.outcome
     yield _fast_result(
@@ -377,7 +412,7 @@ def mine_rolled_fast(
 
 def _mine_rolled_fast_segmented(
     req, verify, hw1_cap, *, slab, depth, engine, tiles_per_step,
-    cand_bits, counters,
+    cand_bits, counters, progress=None,
 ) -> Iterator[Optional[Result]]:
     """The pre-batching baseline (``roll_batch=1``): one scalar roll +
     one drained-to-completion ``CandidateSearch`` per extranonce
@@ -396,6 +431,7 @@ def _mine_rolled_fast_segmented(
     seg_slab = slab if engine == "pallas" else width
     searched = 0
     candidates = []  # (global index, hash)
+    best_hg = None  # (hash, global index) running min over candidates
     for en, base_g, n_lo, n_hi in chain.rolled_segments(
         req.lower, req.upper, req.nonce_bits
     ):
@@ -424,10 +460,25 @@ def _mine_rolled_fast_segmented(
             slab=seg_slab, depth=depth,
         )
         for _ in search.events():
+            if progress is not None and search.outcome is None:
+                local = search.settled_high_water()
+                if local is not None:
+                    hw = base_g | local
+                elif base_g > req.lower:
+                    hw = base_g - 1  # prior segments fully settled
+                else:
+                    hw = None
+                if hw is not None:
+                    seg_best = search.best_candidate()
+                    pool = [b for b in (best_hg, seg_best and (
+                        seg_best[0], base_g | seg_best[1])) if b]
+                    bh, bg = min(pool) if pool else (MIN_UNTRACKED, req.lower)
+                    progress(hw, bg, bh)
             yield None
         out = search.outcome
         searched += out.searched
         candidates += [(base_g | n, h) for n, h in out.candidates]
+        best_hg = min(((h, g) for g, h in candidates), default=None)
         if out.found:
             yield _fast_result(
                 req, True, base_g | out.nonce, out.hash_value, searched,
@@ -495,6 +546,7 @@ def mine_rolled_tracking(
     depth: int = 2,
     roll_batch: int = 8,
     counters: Optional[Dict[str, int]] = None,
+    progress: Optional[ProgressFn] = None,
 ) -> Iterator[Optional[Result]]:
     """Exact rolled search (CpuMiner-compatible first winner AND
     exhausted minimum), batched: windows of ``roll_batch`` roll rows
@@ -550,6 +602,10 @@ def mine_rolled_tracking(
         cand = (ops.digest_to_int(row[11:19]), start + int(row[2]))
         if best is None or cand < best:
             best = cand
+        if progress is not None:
+            # windows resolve in dispatch order, so the settled prefix
+            # ends exactly at this (clamped) window's last index
+            progress(min(start + window, req.upper + 1) - 1, best[1], best[0])
         yield None
     yield Result(
         req.job_id, req.mode, best[1], best[0],

@@ -203,6 +203,29 @@ class CandidateSearch:
         starts += [s for s, _, _ in self._inflight]
         return min(starts) if starts else None
 
+    def settled_high_water(self) -> Optional[int]:
+        """Highest index ``g`` such that every index in ``[lower, g]``
+        has been verifiably swept with no winner accepted below it, or
+        None when nothing is settled yet. The source a rolled worker's
+        progress beacon reads from: while the search is running, every
+        candidate below the unsearched minimum has already been
+        host-verified (a win would have finished or pinned the search),
+        so ``[lower, settled_high_water()]`` is safe for the coordinator
+        to journal as a partial settle."""
+        lo = self._unsearched_min()
+        if lo is None:
+            return self.upper
+        if lo <= self.lower:
+            return None
+        return lo - 1
+
+    def best_candidate(self) -> Optional[Tuple[int, int]]:
+        """(hash, nonce) minimum over candidates surfaced so far, or
+        None — the running min-fold a progress beacon carries."""
+        if not self._candidates:
+            return None
+        return min((h, n) for n, h in self._candidates)
+
     def _try_finish(self) -> bool:
         if not self._wins:
             if self._pending or self._inflight:

@@ -200,6 +200,7 @@ class TpuMiner(Miner):
         yield from rolled.mine_rolled_fast(
             req, slab=self.slab, depth=self.depth,
             roll_batch=self.roll_batch, engine="pallas",
+            progress=self.progress_cb,
         )
 
     def _mine_rolled_tracking(self, req: Request) -> Iterator[Optional[Result]]:
@@ -218,7 +219,7 @@ class TpuMiner(Miner):
 
             yield from rolled.mine_rolled_tracking(
                 req, width_cap=min(self.slab, 1 << 16), depth=self.depth,
-                roll_batch=self.roll_batch,
+                roll_batch=self.roll_batch, progress=self.progress_cb,
             )
             return
         cb = chain.CoinbaseTemplate(
@@ -252,6 +253,10 @@ class TpuMiner(Miner):
             cand = (seg_result.hash_value, g)
             if best is None or cand < best:
                 best = cand
+            if self.progress_cb is not None and (base_g | n_hi) < req.upper:
+                # segment-boundary granularity is enough for the
+                # roll_batch=1 baseline arm
+                self.progress_cb(base_g | n_hi, best[1], best[0])
         yield Result(
             req.job_id, req.mode, best[1], best[0],
             found=best[0] <= req.target,

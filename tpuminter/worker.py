@@ -26,6 +26,7 @@ import asyncio
 import logging
 import random
 import struct
+import time
 from typing import Callable, Iterator, Optional
 
 from tpuminter import chain
@@ -35,7 +36,9 @@ from tpuminter.lsp.params import FAST
 from dataclasses import replace as dc_replace
 
 from tpuminter.protocol import (
+    MIN_UNTRACKED,
     Assign,
+    Beacon,
     Cancel,
     Join,
     Message,
@@ -44,6 +47,7 @@ from tpuminter.protocol import (
     Refuse,
     Request,
     Result,
+    RollAssign,
     Setup,
     decode_msg,
     encode_msg,
@@ -75,6 +79,14 @@ class Miner:
     #: carves chunks covering multiple spans (single-span chunks drain
     #: the pipeline at every boundary — coordinator.SPANS_PER_DISPATCH)
     span = 0
+    #: optional ``(high_water, best_nonce, best_hash)`` callback
+    #: (``rolled.ProgressFn``) the role loop installs per roll-budget
+    #: chunk; rolled mine paths call it at batch/window boundaries with
+    #: the settled global-index high-water so the loop can emit Beacon
+    #: progress. Runs on the mining (executor) thread — implementations
+    #: must stay tiny and lock-free (the installed one just stores a
+    #: tuple). None (the default) disables progress tracking entirely.
+    progress_cb: Optional[Callable[[int, int, int], None]] = None
 
     def mine(self, request: Request) -> Iterator[Optional[Result]]:
         raise NotImplementedError
@@ -184,6 +196,14 @@ class CpuMiner(Miner):
                 nonce = stop
                 # + not |: at a segment end nonce is n_hi+1, past the mask
                 if base_g + nonce <= req.upper:
+                    if self.progress_cb is not None:
+                        # every index through base_g + nonce - 1 is fully
+                        # hashed with no winner (a winner returned above)
+                        self.progress_cb(
+                            base_g + nonce - 1, best_nonce,
+                            best_hash if best_hash is not None
+                            else MIN_UNTRACKED,
+                        )
                     yield None
         yield Result(
             req.job_id, req.mode, best_nonce, best_hash,
@@ -240,6 +260,9 @@ class ProfiledMiner(Miner):
         if self._tracing:
             log.info("closing trace abandoned by a cancelled chunk")
             self._stop_trace()
+        # the role loop (re)installs progress_cb on THIS wrapper per
+        # chunk; the inner miner is what actually reads it while mining
+        self._inner.progress_cb = self.progress_cb
         if self._traced:
             yield from self._inner.mine(request)
             return
@@ -292,6 +315,8 @@ async def run_miner(
     on_result: Optional[Callable[[Result], None]] = None,
     binary: bool = True,
     connect_epochs: Optional[int] = None,
+    roll: bool = True,
+    beacon_interval: float = 2.0,
 ) -> None:
     """Worker role main loop; returns when the coordinator is lost.
 
@@ -306,13 +331,24 @@ async def run_miner(
     decodes them — so an old coordinator gets JSON forever and nothing
     needs a flag day. ``binary=False`` pins this worker to JSON (the
     interop tests' "old peer" stand-in).
+
+    ``roll`` advertises the roll-budget dialect the same way: a
+    roll-capable coordinator may then dispatch this worker
+    :class:`RollAssign` chunks (extranonce-unit, ``count · 2^nonce_bits``
+    indices each), and for exactly those chunks the loop emits
+    :class:`Beacon` progress — the settled global-index high-water plus
+    the running min-fold — at most every ``beacon_interval`` seconds
+    (the cadence knob; ≤ 0 disables emission). Beacons only flow for
+    chunks that ARRIVED as a RollAssign, so an old coordinator never
+    sees one. ``roll=False`` pins this worker to classic global-index
+    chunks (the interop tests' "old peer" stand-in).
     """
     client = await LspClient.connect(
         host, port, params or FAST, connect_epochs=connect_epochs
     )
     client.write(encode_msg(Join(
         backend=miner.backend, lanes=miner.lanes, span=miner.span,
-        codec="bin" if binary else "json",
+        codec="bin" if binary else "json", roll=roll,
     )))
     speak_binary = False
 
@@ -354,7 +390,8 @@ async def run_miner(
                 while len(templates) > _TEMPLATE_CAP:
                     templates.pop(next(iter(templates)))
                 continue
-            if isinstance(msg, Assign):
+            roll_chunk = False
+            if isinstance(msg, (Assign, RollAssign)):
                 tmpl = templates.get(msg.job_id)
                 if tmpl is None:
                     # template missing (evicted by a hedge-loser Cancel or
@@ -369,8 +406,18 @@ async def run_miner(
                         Refuse(msg.job_id, msg.chunk_id), binary=speak_binary
                     ))
                     continue
+                if isinstance(msg, RollAssign):
+                    # extranonce-unit dispatch: expand against the cached
+                    # template's nonce_bits — count whole segments, full
+                    # 2^nonce_bits nonces each (protocol.RollAssign)
+                    roll_chunk = True
+                    lower, upper = chain.roll_span(
+                        msg.extranonce0, msg.count, tmpl.nonce_bits
+                    )
+                else:
+                    lower, upper = msg.lower, msg.upper
                 msg = dc_replace(
-                    tmpl, lower=msg.lower, upper=msg.upper, chunk_id=msg.chunk_id
+                    tmpl, lower=lower, upper=upper, chunk_id=msg.chunk_id
                 )
             if not isinstance(msg, Request):
                 log.warning("worker: unexpected %s, dropping", type(msg).__name__)
@@ -382,6 +429,20 @@ async def run_miner(
             # must never block the event loop — epoch heartbeats stopping
             # would get this worker declared dead mid-compile.
             loop = asyncio.get_running_loop()
+            # roll-budget chunks: install a latest-value progress cell the
+            # mining thread stores into (GIL-safe tuple write), and emit a
+            # Beacon at most every beacon_interval seconds. Installed (or
+            # cleared) unconditionally per chunk so a stale callback never
+            # outlives its chunk.
+            latest: dict = {}
+            if roll_chunk and beacon_interval > 0:
+                miner.progress_cb = (
+                    lambda hw, n, h: latest.__setitem__("p", (hw, n, h))
+                )
+            else:
+                miner.progress_cb = None
+            last_beacon = time.monotonic()
+            beacon_hw = -1
             gen = miner.mine(msg)
             result: Optional[Result] = None
             cancelled = False
@@ -393,6 +454,22 @@ async def run_miner(
                 if item is not None:
                     result = item
                     break
+                prog = latest.get("p")
+                if (
+                    prog is not None
+                    and time.monotonic() - last_beacon >= beacon_interval
+                ):
+                    hw, bn, bh = prog
+                    hw = min(hw, msg.upper)
+                    # hw == upper means the chunk is done — the final
+                    # Result (imminent) settles it; don't beacon
+                    if msg.lower <= hw < msg.upper and hw > beacon_hw:
+                        client.write(encode_msg(
+                            Beacon(msg.job_id, msg.chunk_id, hw, bn, bh),
+                            binary=speak_binary,
+                        ))
+                        last_beacon = time.monotonic()
+                        beacon_hw = hw
                 if read_task is None:
                     read_task = asyncio.ensure_future(client.read())
                 if read_task.done():
@@ -451,6 +528,8 @@ async def run_miner_reconnect(
     rng: Optional[random.Random] = None,
     binary: bool = True,
     addrs: Optional[list] = None,
+    roll: bool = True,
+    beacon_interval: float = 2.0,
 ) -> None:
     """Worker serve loop that survives coordinator restarts (ISSUE 3).
 
@@ -489,6 +568,7 @@ async def run_miner_reconnect(
             await run_miner(
                 h, p, miner, params=params, on_result=on_result,
                 binary=binary, connect_epochs=connect_epochs,
+                roll=roll, beacon_interval=beacon_interval,
             )
             # had a live session: fresh backoff episode
             delays = jittered_backoff(base_backoff, max_backoff, rng)
@@ -619,6 +699,18 @@ def main(argv: Optional[list] = None) -> None:
         "into DIR (viewable with tensorboard/xprof)",
     )
     parser.add_argument(
+        "--beacon-interval", type=float, default=2.0, metavar="SECS",
+        help="minimum seconds between sub-chunk progress beacons on a "
+        "roll-budget chunk (default 2.0; <= 0 disables emission — the "
+        "coordinator then sees no progress until the final Result)",
+    )
+    parser.add_argument(
+        "--no-roll", action="store_true",
+        help="do not advertise the roll-budget dialect: this worker only "
+        "ever receives classic global-index Assigns (the interop "
+        "'old peer' stand-in; README 'Roll-budget chunks')",
+    )
+    parser.add_argument(
         "--codec", choices=("binary", "json"), default="binary",
         help="wire codec advertised to the coordinator (binary = the "
         "struct-packed fast path, negotiated — an old coordinator "
@@ -694,10 +786,12 @@ def main(argv: Optional[list] = None) -> None:
     if args.reconnect:
         asyncio.run(run_miner_reconnect(
             host, port, miner, binary=args.codec == "binary", addrs=addrs,
+            roll=not args.no_roll, beacon_interval=args.beacon_interval,
         ))
     else:
         asyncio.run(run_miner(
             host, port, miner, binary=args.codec == "binary",
+            roll=not args.no_roll, beacon_interval=args.beacon_interval,
         ))
 
 
