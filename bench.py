@@ -1105,6 +1105,7 @@ def bench_rolled_cp(duration: float = 1.5, smoke: bool = False) -> dict:
             8, 2 if smoke else 4, duration, nonce_bits=nb,
         ))
         roll, classic = m["roll"], m["classic"]
+        bad = loadgen.rolled_check(m)
         out.update({
             f"rolled_cp_msgs_per_segment_budget_nb{nb}": (
                 roll["ctrl_msgs_per_segment"]
@@ -1127,9 +1128,112 @@ def bench_rolled_cp(duration: float = 1.5, smoke: bool = False) -> dict:
             f"rolled_cp_beacon_overhead_pct_nb{nb}": (
                 roll["beacon_overhead_pct"]
             ),
-            f"rolled_cp_violations_nb{nb}": len(loadgen.rolled_check(m)),
+            f"rolled_cp_violations_nb{nb}": len(bad),
         })
+        # a bare count is undiagnosable from a CI log: name the gate(s)
+        if bad:
+            out[f"rolled_cp_violation_detail_nb{nb}"] = bad
     return out
+
+
+def bench_workload(duration: float = 1.5, smoke: bool = False) -> dict:
+    """Pluggable-workload seam cost (ISSUE 15), CPU-only like the other
+    loadgen-backed sections: the same coordinator + CpuMiner shape
+    serves (a) plain MIN mining jobs and (b) hashcore jobs cycling all
+    four fold disciplines, closed-loop, over identical index ranges —
+    measured PAIRED so the fold seam's overhead on the shared
+    dispatch/settle/journal plane is a number, not a belief.
+
+    - ``workload_jobs_per_s_{mining,hashcore}`` — end-to-end answered
+      jobs/s per arm. The pairing is the regression tripwire: a
+      hashcore collapse, or a mining dip after the fold refactor of
+      the coordinator, shows here first.
+    - ``workload_indices_per_s_hashcore`` — settled indices/s across
+      the fold arm (the workload plane's raw scan throughput,
+      verification included).
+    - ``workload_folds_covered`` — distinct fold disciplines answered
+      (4 = fmin, topk, fmatch, fsum all flowed end to end).
+    """
+    import asyncio
+
+    upper = 4095 if smoke else 16383
+
+    async def arm(workload: bool) -> tuple:
+        from tpuminter.coordinator import Coordinator
+        from tpuminter.lsp import LspClient
+        from tpuminter.lsp.params import FAST
+        from tpuminter.protocol import (
+            PowMode,
+            Request,
+            Result,
+            WorkResult,
+            decode_msg,
+            encode_msg,
+        )
+        from tpuminter.worker import CpuMiner, run_miner
+        from tpuminter.workloads import hashcore as hc
+
+        coord = await Coordinator.create(params=FAST, chunk_size=2048)
+        serve = asyncio.ensure_future(coord.serve())
+        miners = [
+            asyncio.ensure_future(
+                run_miner("127.0.0.1", coord.port, CpuMiner())
+            )
+            for _ in range(2)
+        ]
+        variants = ("fmin", "topk", "fmatch", "fsum")
+        jobs = searched = 0
+        folds_seen = set()
+        # ONE connection for the whole arm, the load clients' idiom:
+        # per-job dials would measure dial latency, not the plane
+        client = await LspClient.connect("127.0.0.1", coord.port, FAST)
+        t0 = time.perf_counter()
+        try:
+            while time.perf_counter() - t0 < duration:
+                jobs += 1
+                if workload:
+                    # threshold=0 keeps fmatch a full dry scan: every
+                    # arm and variant settles the identical index range
+                    v = variants[jobs % len(variants)]
+                    req = Request(
+                        job_id=jobs, mode=PowMode.MIN, lower=0,
+                        upper=upper,
+                        data=hc.pack_params(v, seed=jobs, threshold=0),
+                        workload="hashcore",
+                    )
+                    folds_seen.add(v)
+                else:
+                    req = Request(
+                        job_id=jobs, mode=PowMode.MIN, lower=0,
+                        upper=upper, data=b"bench-%d" % jobs,
+                    )
+                client.write(encode_msg(req))
+                while True:
+                    msg = decode_msg(await client.read())
+                    if (
+                        isinstance(msg, (Result, WorkResult))
+                        and msg.job_id == jobs
+                    ):
+                        break
+                searched += msg.searched
+            dt = time.perf_counter() - t0
+        finally:
+            await client.close(drain_timeout=0.2)
+            for t in miners:
+                t.cancel()
+            serve.cancel()
+            await asyncio.gather(serve, *miners, return_exceptions=True)
+            await coord.close()
+        return jobs / dt, searched / dt, len(folds_seen)
+
+    mining_jps, _mining_ips, _ = asyncio.run(arm(False))
+    hc_jps, hc_ips, folds_covered = asyncio.run(arm(True))
+    return {
+        "workload_jobs_per_s_mining": round(mining_jps, 2),
+        "workload_jobs_per_s_hashcore": round(hc_jps, 2),
+        "workload_indices_per_s_hashcore": round(hc_ips, 1),
+        "workload_folds_covered": folds_covered,
+    }
 
 
 def bench_native(seconds: float = 2.0) -> dict:
@@ -1201,6 +1305,7 @@ def main() -> None:
         extra.update(bench_admission(smoke=True))
         extra.update(bench_rolled(pairs=1, nb_points=(8,)))
         extra.update(bench_rolled_cp(duration=1.0, smoke=True))
+        extra.update(bench_workload(duration=1.0, smoke=True))
         extra.update(bench_native(seconds=0.5))
     elif jax.default_backend() == "cpu":
         # the TPU tunnel is down and jax silently fell back to CPU: say
@@ -1220,6 +1325,7 @@ def main() -> None:
         extra.update(bench_admission())
         extra.update(bench_rolled())
         extra.update(bench_rolled_cp())
+        extra.update(bench_workload())
         extra.update(bench_native())
     else:
         # persistent compilation cache, same as the worker CLI: the
@@ -1254,6 +1360,7 @@ def main() -> None:
         extra.update(bench_admission())
         extra.update(bench_rolled())
         extra.update(bench_rolled_cp())
+        extra.update(bench_workload())
         extra.update(bench_native())
     ghs = rate / 1e9
     print(

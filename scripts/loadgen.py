@@ -124,6 +124,7 @@ from tpuminter.protocol import (  # noqa: E402
     Result,
     RollAssign,
     Setup,
+    WorkResult,
     codec_stats,
     decode_msg,
     encode_msg,
@@ -1608,6 +1609,374 @@ def failover_check(metrics: dict, params: Params = FAST) -> list:
 
 
 # ---------------------------------------------------------------------------
+# workload scenario (ISSUE 15): the second workload through crash + failover
+# ---------------------------------------------------------------------------
+
+#: The pluggable-workload drill's job seed — every shape below derives
+#: its exact expected answer from it locally, so the ledger checks
+#: VALUES per fold, not just exactly-once delivery.
+_WL_SEED = 0xD1CE
+
+
+def _wl_shapes(upper: int, k: int = 4) -> list:
+    """One submission template per fold discipline — ``(name, params
+    bytes, checker)`` — each checker judging the decoded job-level
+    accumulator against the locally-computed exact answer.
+
+    ``fmatch`` ships twice: a guaranteed hit (threshold = the global
+    minimum, so the first match IS the argmin and the early-cancel
+    broadcast fires on every job) and a guaranteed dry scan (threshold
+    0 — the objective is a splitmix64 draw; the precompute faults the
+    drill if a zero ever lands in range). A matched first-match pins
+    only (index, value): its job-level probe count depends on which
+    in-flight chunks the cancel broadcast beat to the settle, by
+    design. The dry one pins the full probe count — every index in the
+    job provably scanned exactly once across failover AND crash."""
+    from tpuminter.workloads import hashcore as hc
+
+    vals = [hc.objective(_WL_SEED, i) for i in range(upper + 1)]
+    lo_val, lo_idx = min((v, i) for i, v in enumerate(vals))
+    if lo_val == 0:
+        raise RuntimeError(
+            "degenerate _WL_SEED: the dry first-match shape is impossible"
+        )
+    topk = sorted((v, i) for i, v in enumerate(vals))[:k]
+    total = sum(vals)
+    return [
+        ("fmin", hc.pack_params("fmin", _WL_SEED),
+         lambda acc: list(acc or ()) == [lo_val, lo_idx]),
+        ("topk", hc.pack_params("topk", _WL_SEED, k=k),
+         lambda acc: [tuple(p) for p in acc or ()] == topk),
+        ("fmatch_hit", hc.pack_params("fmatch", _WL_SEED, threshold=lo_val),
+         lambda acc: acc is not None and acc[0] == lo_idx
+         and acc[1] == lo_val),
+        ("fmatch_dry", hc.pack_params("fmatch", _WL_SEED, threshold=0),
+         lambda acc: acc is not None and acc[0] is None
+         and acc[2] == upper + 1),
+        ("fsum", hc.pack_params("fsum", _WL_SEED),
+         lambda acc: list(acc or ()) == [total, upper + 1]),
+    ]
+
+
+async def _workload_client_loop(
+    ports, params: Params, cid: int, shapes, upper: int, ledger: dict,
+) -> None:
+    """The durable client loop (:func:`_durable_client_loop`) for
+    pluggable-workload jobs: cycles through ``shapes`` (one Request
+    template per fold discipline, staggered per client so a short
+    drill still covers every fold), survives coordinator restarts
+    under a durable client_key, books every answer in the exactly-once
+    ledger AND checks each decoded accumulator against the shape's
+    ground truth — a wrong value books ``ledger['answers_wrong']``, a
+    strictly stronger claim than exactly-once delivery."""
+    import random as _random
+
+    from tpuminter import workloads
+    from tpuminter.replication import dial_patience
+
+    if isinstance(ports, int):
+        ports = [ports]
+    rng = _random.Random(3000 + cid)
+    ckey = f"loadgen-wl-{cid}"
+    answers = ledger["answers"]
+    by_fold = ledger["by_fold"]
+    jid = 0
+    attempt = 0
+    pending = None  # (Request, shape name, checker)
+    client: Optional[LspClient] = None
+    delays = jittered_backoff(0.05, 1.0, rng)
+    try:
+        while True:
+            if client is None:
+                port = ports[attempt % len(ports)]
+                attempt += 1
+                try:
+                    client = await LspClient.connect(
+                        "127.0.0.1", port, params,
+                        connect_epochs=dial_patience(ports),
+                    )
+                    delays = jittered_backoff(0.05, 1.0, rng)
+                except LspConnectError:
+                    await asyncio.sleep(next(delays))
+                    continue
+                if pending is not None:
+                    # same client_key + job_id: the restarted
+                    # coordinator re-binds or answers from its journal
+                    client.write(encode_msg(pending[0]))
+            try:
+                if pending is None:
+                    if ledger.get("stop"):
+                        return
+                    name, data, check = shapes[(cid + jid) % len(shapes)]
+                    jid += 1
+                    req = Request(
+                        job_id=jid, mode=PowMode.MIN, lower=0, upper=upper,
+                        data=data, client_key=ckey, workload="hashcore",
+                    )
+                    pending = (req, name, check)
+                    ledger["submitted"] += 1
+                    client.write(encode_msg(req))
+                msg = decode_msg(await client.read())
+                if isinstance(msg, (Result, WorkResult)):
+                    # book EVERY answer (duplicate detection), not just
+                    # the awaited one
+                    key = (cid, msg.job_id)
+                    answers[key] = answers.get(key, 0) + 1
+                    if (
+                        pending is not None
+                        and msg.job_id == pending[0].job_id
+                    ):
+                        req, name, check = pending
+                        ok = isinstance(msg, WorkResult)
+                        if ok:
+                            try:
+                                acc = workloads.fold_of(req).decode(
+                                    bytes(msg.payload)
+                                )
+                            except ValueError:
+                                ok = False
+                            else:
+                                ok = bool(check(acc))
+                        if not ok:
+                            ledger["answers_wrong"] = (
+                                ledger.get("answers_wrong", 0) + 1
+                            )
+                            ledger.setdefault("wrong_sample", []).append(
+                                name
+                            )
+                        by_fold[name] = by_fold.get(name, 0) + 1
+                        pending = None
+                elif (
+                    isinstance(msg, Refuse)
+                    and pending is not None
+                    and msg.job_id == pending[0].job_id
+                ):
+                    if msg.retry_after_ms > 0:
+                        # admission backpressure: wait it out, re-submit
+                        await asyncio.sleep(
+                            msg.retry_after_ms / 1000.0
+                            * (0.5 + rng.random())
+                        )
+                        client.write(encode_msg(pending[0]))
+                    else:
+                        # fail-fast Refuse: the coordinator rejected the
+                        # workload itself. Never expected here (hashcore
+                        # is registered everywhere) — book it fatal
+                        ledger["refused_fatal"] = (
+                            ledger.get("refused_fatal", 0) + 1
+                        )
+                        pending = None
+            except LspConnectionLost:
+                await client.close(drain_timeout=0.1)
+                client = None
+                await asyncio.sleep(next(delays))
+    finally:
+        if client is not None:
+            await client.close(drain_timeout=0.2)
+
+
+async def run_workload(
+    n_miners: int = 4,
+    n_clients: int = 2,
+    *,
+    journal_path: Optional[str] = None,
+    chunk_size: int = 1024,
+    chunks_per_job: Optional[int] = None,
+    params: Params = FAST,
+    pre: float = 1.5,
+    post: float = 2.0,
+    drain: float = 10.0,
+    binary: bool = True,
+    pipeline_depth: int = 2,
+) -> dict:
+    """The pluggable-workload drill (ISSUE 15): REAL CpuMiner workers
+    (the hashcore compute seam, not the instant-answer fleet) serve
+    hashcore jobs across every registered fold discipline while the
+    drill applies BOTH legs of the exactly-once story:
+
+    - **worker failover**: one worker is killed abruptly mid-burst
+      (its in-flight chunks die with it) — the coordinator requeues on
+      the epoch horizon and the remaining fleet absorbs the work;
+    - **coordinator crash**: in-process ``kill -9`` of the journaled
+      coordinator, restart from the journal on the SAME port, fleet
+      and clients resume unattended (the ``--scenario crash`` shape,
+      now carrying workload settle records and wstate snapshots).
+
+    The ledger is stricter than the mining drills': every answer's
+    decoded accumulator is checked against the exact locally-computed
+    answer for its fold, so a replayed settle, a lost partial, or a
+    double-counted non-idempotent fold (fsum) surfaces as
+    ``answers_wrong`` even when delivery itself was exactly-once."""
+    import shutil
+
+    from tpuminter.worker import CpuMiner, run_miner_reconnect
+
+    tmpdir = None
+    if journal_path is None:
+        tmpdir = tempfile.mkdtemp(prefix="tpuminter-workload-")
+        journal_path = os.path.join(tmpdir, "coordinator.wal")
+    coord = await make_coordinator(
+        params=params, chunk_size=chunk_size, recover_from=journal_path,
+        binary_codec=binary, pipeline_depth=pipeline_depth,
+    )
+    port = coord.port
+    serve = asyncio.ensure_future(coord.serve())
+    if chunks_per_job is None:
+        chunks_per_job = max(4, n_miners)
+    upper = chunk_size * chunks_per_job - 1
+    shapes = _wl_shapes(upper)
+    ledger = {"answers": {}, "by_fold": {}, "submitted": 0, "stop": False}
+
+    def spawn_miner(i: int):
+        import random as _random
+
+        return asyncio.ensure_future(run_miner_reconnect(
+            "127.0.0.1", port, CpuMiner(), params=params,
+            base_backoff=0.05, max_backoff=1.0,
+            rng=_random.Random(7000 + i), binary=binary,
+        ))
+
+    miners = [spawn_miner(i) for i in range(n_miners)]
+    clients = [
+        asyncio.ensure_future(
+            _workload_client_loop(port, params, i, shapes, upper, ledger)
+        )
+        for i in range(n_clients)
+    ]
+    metrics: dict = {
+        "fleet": n_miners, "clients": n_clients, "chunk_size": chunk_size,
+        "folds": [name for name, _data, _check in shapes],
+    }
+    state = {"coord": coord}
+    try:
+        await asyncio.sleep(pre)
+        # -- leg 1: worker failover (one worker dies, no goodbye) --------
+        miners[0].cancel()
+        await asyncio.gather(miners[0], return_exceptions=True)
+        metrics["worker_killed"] = True
+        await asyncio.sleep(max(0.5, pre / 2))
+        # -- leg 2: kill -9 the coordinator mid-burst --------------------
+        state["coord"] = None
+        serve.cancel()
+        await asyncio.gather(serve, return_exceptions=True)
+        await _crash_coordinator(coord)
+        # -- restart from the journal on the same port -------------------
+        t_restart0 = time.monotonic()
+        for att in range(50):
+            try:
+                coord = await make_coordinator(
+                    port, params=params, chunk_size=chunk_size,
+                    recover_from=journal_path, binary_codec=binary,
+                    pipeline_depth=pipeline_depth,
+                )
+                break
+            except OSError:
+                if att == 49:
+                    raise
+                await asyncio.sleep(0.02)
+        state["coord"] = coord
+        metrics["recovered_jobs"] = len(coord._jobs)
+        metrics["recovered_winners"] = len(coord._winners)
+        metrics["replay_ms"] = round(
+            (time.monotonic() - t_restart0) * 1e3, 3
+        )
+        serve = asyncio.ensure_future(coord.serve())
+        while coord._next_chunk_id == 1:
+            if time.monotonic() - t_restart0 > max(post, 10.0):
+                break
+            await asyncio.sleep(0.001)
+        metrics["restart_to_first_assign_ms"] = round(
+            (time.monotonic() - t_restart0) * 1e3, 3
+        )
+        await asyncio.sleep(post)
+        # -- drain: no new jobs; in-flight ones get `drain` s to answer --
+        ledger["stop"] = True
+        done, pending_tasks = await asyncio.wait(clients, timeout=drain)
+        for t in pending_tasks:
+            t.cancel()
+        await asyncio.gather(*clients, return_exceptions=True)
+        # -- the per-fold exact-answer ledger ----------------------------
+        answers = ledger["answers"]
+        metrics["submitted"] = ledger["submitted"]
+        metrics["answered"] = sum(1 for c in answers.values() if c >= 1)
+        metrics["answers_duplicated"] = sum(
+            c - 1 for c in answers.values() if c > 1
+        )
+        metrics["answers_lost"] = ledger["submitted"] - metrics["answered"]
+        metrics["answers_wrong"] = ledger.get("answers_wrong", 0)
+        metrics["wrong_sample"] = ledger.get("wrong_sample", [])[:8]
+        metrics["refused_fatal"] = ledger.get("refused_fatal", 0)
+        metrics["answered_by_fold"] = dict(
+            sorted(ledger["by_fold"].items())
+        )
+        metrics["results_accepted"] = coord.stats["results_accepted"]
+        metrics["results_rejected"] = coord.stats["results_rejected"]
+        if coord._journal is not None:
+            metrics["journal"] = dict(coord._journal.stats)
+        return metrics
+    finally:
+        for t in clients + miners:
+            t.cancel()
+        await asyncio.gather(*clients, *miners, return_exceptions=True)
+        serve.cancel()
+        await asyncio.gather(serve, return_exceptions=True)
+        if state["coord"] is not None:
+            await state["coord"].close()
+        if tmpdir is not None:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def workload_check(metrics: dict) -> list:
+    """The workload drill's pass/fail assertions (tier-1 gate shape):
+    jobs flowed across every fold discipline, every answer carried the
+    exact locally-computed value for its fold, the ledger is
+    exactly-once, and the fleet resumed after the crash unattended."""
+    bad = []
+    if metrics.get("answered", 0) <= 0:
+        bad.append(f"no workload requests answered at all: {metrics}")
+    folds = metrics.get("folds", [])
+    # a client answers its shapes in cycle order, so `clients * folds`
+    # total answers guarantee some client finished a full cycle
+    if metrics.get("answered", 0) >= len(folds) * metrics.get("clients", 1):
+        missing = [
+            name for name in folds
+            if metrics.get("answered_by_fold", {}).get(name, 0) <= 0
+        ]
+        if missing:
+            bad.append(
+                f"fold discipline(s) never answered despite a full "
+                f"cycle's worth of answers: {missing}"
+            )
+    if metrics.get("answers_wrong", 0) > 0:
+        bad.append(
+            f"{metrics['answers_wrong']} answer(s) decoded to the WRONG "
+            f"value for their fold (shapes: {metrics.get('wrong_sample')})"
+            f" — a broken settle/replay, not a delivery failure"
+        )
+    if metrics.get("answers_duplicated", 0) > 0:
+        bad.append(
+            f"{metrics['answers_duplicated']} duplicate answer(s): a "
+            f"client saw the same request id answered twice"
+        )
+    if metrics.get("answers_lost", 0) > 0:
+        bad.append(
+            f"{metrics['answers_lost']} request(s) never answered "
+            f"despite the drain window"
+        )
+    if metrics.get("refused_fatal", 0) > 0:
+        bad.append(
+            f"{metrics['refused_fatal']} fail-fast Refuse(s) for a "
+            f"registered workload"
+        )
+    if metrics.get("restart_to_first_assign_ms", 1e9) > 10_000:
+        bad.append(
+            "fleet did not resume within 10 s of the restart: "
+            f"{metrics.get('restart_to_first_assign_ms')} ms"
+        )
+    return bad
+
+
+# ---------------------------------------------------------------------------
 # chaos scenario (ISSUE 12): the deterministic fault-plan matrix
 # ---------------------------------------------------------------------------
 
@@ -2897,7 +3266,7 @@ def main(argv=None) -> int:
         "--scenario",
         choices=(
             "steady", "crash", "failover", "chaos", "zipf", "churn",
-            "rolled",
+            "rolled", "workload",
         ),
         default="steady",
         help="steady: the sustained-burst benchmark; crash: kill the "
@@ -2928,7 +3297,12 @@ def main(argv=None) -> int:
         "--roll-budget armed and once at budget 0 (global-index "
         "chunks), gated on the RollAssign path demonstrably engaging, "
         ">= 1000x fewer control messages per 2^32 settled indices, "
-        "and beacon overhead <= 5% of results/s",
+        "and beacon overhead <= 5% of results/s; workload: the "
+        "pluggable-workload drill (ISSUE 15) — a real CpuMiner fleet "
+        "serves hashcore jobs across every registered fold discipline "
+        "(fmin, top-k, first-match hit + dry, map-reduce sum) through "
+        "a worker kill AND a coordinator kill -9 + journal restart, "
+        "gated on a per-fold EXACT-ANSWER exactly-once ledger",
     )
     parser.add_argument(
         "--roll-budget", type=int, default=16, metavar="N",
@@ -3126,6 +3500,25 @@ def main(argv=None) -> int:
         violations = failover_check(metrics) if args.smoke else []
         for v in violations:
             print(f"FAILOVER FAIL: {v}", file=sys.stderr)
+        return 1 if violations else 0
+    if args.scenario == "workload":
+        metrics = asyncio.run(run_workload(
+            4 if args.smoke else max(4, args.miners),
+            2 if args.smoke else max(2, args.clients // 2),
+            journal_path=args.journal, chunk_size=args.chunk_size,
+            pre=min(args.duration, 1.5) if args.smoke
+            else max(1.0, args.duration / 2),
+            post=min(args.duration, 2.0) if args.smoke
+            else args.duration,
+            binary=args.codec == "binary",
+            pipeline_depth=args.pipeline,
+        ))
+        print(json.dumps(metrics) if args.json else
+              "\n".join(f"{k}: {v}" for k, v in metrics.items()))
+        # the drill IS its assertions, --smoke or not (like chaos/zipf)
+        violations = workload_check(metrics)
+        for v in violations:
+            print(f"WORKLOAD FAIL: {v}", file=sys.stderr)
         return 1 if violations else 0
     if args.scenario == "crash":
         if args.smoke and args.loops > 1:

@@ -1,0 +1,28 @@
+"""True-positive fixture: a careless second-workload port (ISSUE 15).
+
+A new workload's params codec reuses the hashcore params tag 0xC0 (a
+Request.data frame for one workload would parse as the other's — the
+coordinator would verify claims against the wrong objective), its
+accumulator layout collides on packed length with the params layout,
+nothing is sealed with the CRC trailer every workloads codec carries,
+a u64 field packs unguarded, and TWO ``*_WID`` constants claim
+workload id 1 — the dispatch key on binary WorkResult frames and
+recovered winner records, where a collision decodes a winner under
+the wrong workload. Parsed by tests/test_analysis.py, never imported.
+"""
+
+import struct
+
+BADCORE_WID = 1
+OTHERCORE_WID = 1           # workload-id collision (and with hashcore)
+
+_TAG_BCPARAMS = 0xC0        # collides with hashcore's params tag
+_BIN_BCPARAMS = struct.Struct("<BBQQB")
+
+_TAG_BCACC = 0xC5           # same calcsize as params: length collision
+_BIN_BCACC = struct.Struct("<BHQQ")
+
+
+def pack_params(seed: int, threshold: int) -> bytes:
+    # u64 fields packed with no _U64 range guard, no CRC trailer
+    return _BIN_BCPARAMS.pack(_TAG_BCPARAMS, 0, seed, threshold, 1)

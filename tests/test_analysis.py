@@ -179,6 +179,75 @@ def test_codec_conformance_catches_bad_roll_dialect_table():
     )
 
 
+def test_codec_conformance_catches_bad_workload_port():
+    """The ISSUE 15 bug class: a second-workload port that reuses the
+    hashcore params tag, collides on packed length, skips the CRC
+    trailer, and packs u64 params unguarded must fail lint — and its
+    colliding ``*_WID`` constants must trip the workload-id namespace
+    rule, both within the fixture and cross-module against the real
+    ``HASHCORE_WID``."""
+    from tpuminter.analysis import codec_conformance
+
+    findings = _fixture_findings("workload_bad.py", ["codec-conformance"])
+    violations = {
+        f.symbol.split(":", 1)[0] for f in findings if ":" in f.symbol
+    }
+    assert "length-collision" in violations
+    assert "missing-crc" in violations
+    assert any(
+        f.qualname == "pack_params" and f.symbol == "_BIN_BCPARAMS"
+        for f in findings
+    )
+    fixture = parse_module(
+        REPO_ROOT, os.path.join(FIXTURES, "workload_bad.py")
+    )
+    hashcore = parse_module(
+        REPO_ROOT, os.path.join("tpuminter", "workloads", "hashcore.py")
+    )
+    project = codec_conformance.check_project([fixture, hashcore])
+    symbols = {f.symbol for f in project}
+    # tag 0xC0 claimed by both modules: one wire namespace (every
+    # claimant after the first sorted one is flagged)
+    assert "cross-module-tag:_BIN_HCPARAMS" in symbols
+    # wid 1 claimed three times (twice in the fixture, once for real):
+    # the first claimant keeps the id, the other two are flagged
+    assert "workload-id-collision:OTHERCORE_WID" in symbols
+    assert "workload-id-collision:HASHCORE_WID" in symbols
+    assert "workload-id-collision:BADCORE_WID" not in symbols
+
+
+def test_codec_conformance_covers_the_live_workload_codecs():
+    """The registry-declared workload codecs are under the checker's
+    eye: the hashcore params frame and every fold accumulator layout
+    parse out of ``tpuminter/workloads/`` with distinct tags, distinct
+    packed lengths, and the CRC seal — and the live table is clean."""
+    from tpuminter.analysis.codec_conformance import (
+        check_table,
+        extract_kinds,
+        extract_wids,
+    )
+
+    hashcore = parse_module(
+        REPO_ROOT, os.path.join("tpuminter", "workloads", "hashcore.py")
+    )
+    folds = parse_module(
+        REPO_ROOT, os.path.join("tpuminter", "workloads", "folds.py")
+    )
+    kinds = {
+        k["name"]: k
+        for src in (hashcore, folds)
+        for k in extract_kinds(src)
+    }
+    assert kinds["_BIN_HCPARAMS"]["tag"] == 0xC0
+    fold_layouts = ("_BIN_WMIN", "_BIN_WTOPK", "_BIN_WMATCH", "_BIN_WSUM")
+    tags = {kinds[name]["tag"] for name in fold_layouts}
+    assert len(tags) == len(fold_layouts)  # distinct accumulator tags
+    assert all(kinds[name]["has_crc"] for name in fold_layouts)
+    assert check_table(list(kinds.values())) == []
+    wids = extract_wids(hashcore)
+    assert [w["name"] for w in wids] == ["HASHCORE_WID"]
+
+
 def test_codec_conformance_covers_the_live_roll_dialect():
     """The shipped 0xB9/0xBA kinds are under the checker's eye — parsed
     out of tpuminter/protocol.py with the right tags, distinct packed

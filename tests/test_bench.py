@@ -57,3 +57,10 @@ def test_bench_smoke_emits_one_json_line():
             obj["extra"][f"rolled_cp_beacon_overhead_pct_nb{nb}"] <= 5.0
         )
     assert obj["extra"]["rolled_cp_collapse_ratio_msgs_nb32"] >= 1000.0
+    # the pluggable-workload pairing rides every capture (ISSUE 15):
+    # both arms of the seam-cost A/B measured on the same plane, and
+    # every fold discipline actually flowed end to end
+    assert obj["extra"]["workload_jobs_per_s_mining"] > 0
+    assert obj["extra"]["workload_jobs_per_s_hashcore"] > 0
+    assert obj["extra"]["workload_indices_per_s_hashcore"] > 0
+    assert obj["extra"]["workload_folds_covered"] == 4

@@ -903,3 +903,168 @@ def test_winner_trim_never_evicts_unacked(entries, cap, ttl):
     assert unacked <= survivors
     assert set(table) - survivors == expected
     assert coord.stats["winners_evicted"] == len(expected)
+
+
+# ---------------------------------------------------------------------------
+# fold disciplines (ISSUE 15): the coverage-gated fold state must make
+# every discipline — the non-idempotent sum included — exactly-once
+# under arbitrary chunk partitions, delivery orders, duplicate
+# deliveries, and beacon-style prefix splits (deterministic seeded
+# mirrors live in tests/test_workloads.py — this image lacks
+# hypothesis)
+# ---------------------------------------------------------------------------
+
+from tpuminter.workloads import (  # noqa: E402
+    FMin,
+    FSum,
+    FirstMatch,
+    TopK,
+    absorb,
+    new_state,
+)
+from tpuminter.workloads import hashcore as _hc  # noqa: E402
+
+_FOLD_MAKERS = (
+    lambda: FMin(),
+    lambda: TopK(3),
+    lambda: FirstMatch(1 << 60),
+    lambda: FSum(),
+)
+
+
+def _fold_vals(seed, lo, hi):
+    return [_hc.objective(seed, i) for i in range(lo, hi + 1)]
+
+
+@st.composite
+def _chunk_schedules(draw):
+    """A partition of [0, hi] into chunks, a shuffled delivery order,
+    and a set of duplicate deliveries injected at arbitrary points."""
+    hi = draw(st.integers(5, 200))
+    n_cuts = draw(st.integers(0, 8))
+    cuts = sorted(draw(st.sets(st.integers(1, hi), max_size=n_cuts)))
+    spans, at = [], 0
+    for c in list(cuts) + [hi + 1]:
+        spans.append((at, c - 1))
+        at = c
+    order = draw(st.permutations(list(range(len(spans)))))
+    dups = draw(st.lists(
+        st.integers(0, len(spans) - 1), max_size=3,
+    ))
+    return spans, list(order) + dups
+
+
+@settings(max_examples=80, deadline=None)
+@given(
+    fold_i=st.integers(0, len(_FOLD_MAKERS) - 1),
+    seed=st.integers(0, 2**32 - 1),
+    sched=_chunk_schedules(),
+)
+def test_fold_state_is_schedule_independent(fold_i, seed, sched):
+    """Any delivery order with any duplicates lands on the in-order,
+    exactly-once state: absorb's coverage gate + the folds' assoc/comm
+    combine are jointly what lets replay, out-of-order settles, and WAL
+    merges share one mechanism."""
+    fold = _FOLD_MAKERS[fold_i]()
+    spans, order = sched
+    settles = [
+        (a, b, fold.of_batch(a, _fold_vals(seed, a, b))) for a, b in spans
+    ]
+    baseline = new_state(fold)
+    for a, b, acc in settles:
+        assert absorb(fold, baseline, a, b, acc)
+    state = new_state(fold)
+    for i in order:
+        a, b, acc = settles[i]
+        absorb(fold, state, a, b, acc)   # duplicates must bounce
+    assert state == baseline
+
+
+@settings(max_examples=80, deadline=None)
+@given(
+    fold_i=st.integers(0, len(_FOLD_MAKERS) - 1),
+    seed=st.integers(0, 2**32 - 1),
+    hi=st.integers(1, 150),
+    data=st.data(),
+)
+def test_fold_beacon_prefix_split_settles_exactly(fold_i, seed, hi, data):
+    """A chunk settled as prefix-beacon + remainder equals the whole
+    chunk at once, and replaying the beacon is a no-op — ISSUE 14's
+    sub-chunk progress shape is safe on every discipline. First-match
+    probes are schedule-relative under early-cancel, so only its
+    decided (index, value) must agree."""
+    fold = _FOLD_MAKERS[fold_i]()
+    cut = data.draw(st.integers(0, hi - 1))
+    whole = new_state(fold)
+    assert absorb(fold, whole, 0, hi, fold.of_batch(0, _fold_vals(seed, 0, hi)))
+    beacon = fold.of_batch(0, _fold_vals(seed, 0, cut))
+    rest = fold.of_batch(cut + 1, _fold_vals(seed, cut + 1, hi))
+    split = new_state(fold)
+    assert absorb(fold, split, 0, cut, beacon)
+    assert absorb(fold, split, cut + 1, hi, rest)
+    assert not absorb(fold, split, 0, cut, beacon)
+    assert split["covered"] == whole["covered"] == [[0, hi]]
+    if isinstance(fold, FirstMatch):
+        assert split["acc"][:2] == whole["acc"][:2]
+    else:
+        assert split["acc"] == whole["acc"]
+
+
+@settings(max_examples=120)
+@given(
+    v=st.integers(0, 2**64 - 1),
+    i=st.integers(0, 2**64 - 1),
+    probes=st.integers(1, 2**64 - 1),
+    total=st.integers(0, 2**128 - 1),
+    count=st.integers(0, 2**64 - 1),
+    pairs=st.lists(
+        st.tuples(st.integers(0, 2**64 - 1), st.integers(0, 2**64 - 1)),
+        max_size=8, unique_by=lambda p: p[1],
+    ),
+    data=st.data(),
+)
+def test_fold_payload_roundtrip_and_corruption(
+    v, i, probes, total, count, pairs, data
+):
+    """Every discipline's chunk-partial frame round-trips any in-range
+    accumulator, and any single-byte corruption is a loud ValueError —
+    the CRC trailer is the ONLY corruption check these bytes get on the
+    JSON fallback, so it must hold unconditionally."""
+    cases = [
+        (FMin(), [v, i]),
+        (TopK(8), sorted([list(p) for p in pairs])),
+        (FirstMatch(0), [i, v, probes]),
+        (FSum(), [total, count]),
+    ]
+    for fold, acc in cases:
+        wire = fold.encode(acc)
+        assert fold.decode(wire) == acc
+        pos = data.draw(st.integers(0, len(wire) - 1))
+        flip = data.draw(st.integers(1, 255))
+        bad = bytearray(wire)
+        bad[pos] ^= flip
+        with pytest.raises(ValueError):
+            fold.decode(bytes(bad))
+
+
+@settings(max_examples=100)
+@given(
+    seed=st.integers(0, 2**32 - 1),
+    lo=st.integers(0, 1000),
+    span=st.integers(0, 40),
+    k=st.integers(1, 8),
+)
+def test_topk_ties_always_rank_the_lowest_global_index(seed, lo, span, k):
+    """However a range is chunked, top-k's answer is the first k pairs
+    of the (value, index)-sorted scan — equal values resolve to the
+    LOWER global index, one deterministic list per job."""
+    hi = lo + span
+    fold = TopK(k)
+    values = _fold_vals(seed, lo, hi)
+    want = sorted([val, lo + off] for off, val in enumerate(values))[:k]
+    mid = lo + span // 2
+    acc = fold.combine(
+        fold.of_batch(lo, values[: mid - lo + 1]),
+        fold.of_batch(mid + 1, values[mid - lo + 1:]),
+    )
+    assert acc == want

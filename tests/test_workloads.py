@@ -1,0 +1,645 @@
+"""The pluggable-workload plane (ISSUE 15), pinned at every seam:
+registry collision rules, the params and chunk-partial codecs (tagged +
+CRC-trailed, same discipline as the wire codec), per-fold reduction
+semantics, the coverage gate that makes NON-idempotent folds
+exactly-once under replay, segmented-WAL state merges, the off-loop
+verifier's trust model, the worker compute seam, and — as deterministic
+mirrors of tests/test_properties.py's hypothesis cases (this image
+lacks hypothesis) — seeded random schedules for replay idempotence,
+chunk-order independence, and beacon-style partial-settle splits.
+
+The tier-1 gate for the full fleet drill (`loadgen --scenario workload
+--smoke`: real CpuMiners through a worker kill + a kill -9 coordinator
+crash with an exact-answer-per-fold ledger) rides at the bottom,
+mirroring test_recovery.py's crash-scenario gate.
+"""
+
+import json as _json
+import os
+import random
+import sys
+
+import pytest
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+    ),
+)
+
+import loadgen  # noqa: E402  (scripts/ is not a package)
+
+from tpuminter import workloads  # noqa: E402
+from tpuminter.protocol import (  # noqa: E402
+    PowMode,
+    Request,
+    WorkResult,
+)
+from tpuminter.workloads import (  # noqa: E402
+    FMin,
+    FSum,
+    FirstMatch,
+    TopK,
+    Workload,
+    absorb,
+    absorb_payload,
+    fold_of,
+    merge_states,
+    new_state,
+)
+from tpuminter.workloads import folds  # noqa: E402
+from tpuminter.workloads import hashcore as hc  # noqa: E402
+
+ALL_FOLDS = (FMin(), TopK(3), FirstMatch(1 << 60), FSum())
+
+
+def _req(variant="fmin", seed=7, threshold=0, k=3, lo=0, hi=99,
+         job_id=1, chunk_id=1):
+    return Request(
+        job_id=job_id, mode=PowMode.MIN, lower=lo, upper=hi,
+        data=hc.pack_params(variant, seed=seed, threshold=threshold, k=k),
+        chunk_id=chunk_id, workload="hashcore",
+    )
+
+
+def _vals(seed, lo, hi):
+    return [hc.objective(seed, i) for i in range(lo, hi + 1)]
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_hashcore_is_registered_and_advertised(self):
+        assert "hashcore" in workloads.names()
+        assert workloads.get("hashcore").wid == hc.HASHCORE_WID
+        assert workloads.by_wid(hc.HASHCORE_WID).name == "hashcore"
+        assert workloads.maybe("no-such-workload") is None
+
+    def test_register_rejects_name_and_wid_collisions(self):
+        class Clone(Workload):
+            name = "hashcore"
+            wid = 250
+
+        with pytest.raises(ValueError, match="name"):
+            workloads.register(Clone())
+
+        class WidClash(Workload):
+            name = "widclash-test"
+            wid = hc.HASHCORE_WID
+
+        with pytest.raises(ValueError, match="wid"):
+            workloads.register(WidClash())
+        assert "widclash-test" not in workloads.names()
+
+    def test_register_rejects_bad_identity(self):
+        class NoName(Workload):
+            name = ""
+            wid = 7
+
+        with pytest.raises(ValueError, match="name"):
+            workloads.register(NoName())
+
+        class BadWid(Workload):
+            name = "badwid-test"
+            wid = 256
+
+        with pytest.raises(ValueError, match="u8"):
+            workloads.register(BadWid())
+
+    def test_reregistering_the_same_object_is_idempotent(self):
+        live = workloads.get("hashcore")
+        assert workloads.register(live) is live
+
+
+# ---------------------------------------------------------------------------
+# params codec: tag | fields | crc, every corruption is a loud refusal
+# ---------------------------------------------------------------------------
+
+class TestParamsCodec:
+    def test_roundtrip_every_variant(self):
+        for variant in hc.VARIANTS:
+            p = hc.parse_params(
+                hc.pack_params(variant, seed=99, threshold=5, k=4)
+            )
+            assert (p.variant, p.seed, p.threshold, p.k) == (
+                variant, 99, 5, 4
+            )
+
+    def test_pack_rejects_out_of_range(self):
+        with pytest.raises(ValueError):
+            hc.pack_params("fmin", seed=1 << 64)
+        with pytest.raises(ValueError):
+            hc.pack_params("fmin", seed=1, threshold=-1)
+        with pytest.raises(ValueError):
+            hc.pack_params("topk", seed=1, k=folds.TOPK_SLOTS + 1)
+        with pytest.raises(ValueError):
+            hc.pack_params("nope", seed=1)
+
+    def test_every_single_byte_corruption_is_rejected(self):
+        good = hc.pack_params("fmatch", seed=3, threshold=17, k=2)
+        hc.parse_params(good)
+        for pos in range(len(good)):
+            for flip in (0x01, 0x80, 0xFF):
+                bad = bytearray(good)
+                bad[pos] ^= flip
+                if bytes(bad) == good:
+                    continue
+                with pytest.raises(ValueError):
+                    hc.parse_params(bytes(bad))
+
+    def test_truncation_and_padding_are_rejected(self):
+        good = hc.pack_params("fsum", seed=3)
+        for n in range(len(good)):
+            with pytest.raises(ValueError, match="bytes"):
+                hc.parse_params(good[:n])
+        with pytest.raises(ValueError, match="bytes"):
+            hc.parse_params(good + b"\x00")
+
+    def test_fold_of_resolves_and_refuses(self):
+        assert isinstance(fold_of(_req("topk", k=5)), TopK)
+        assert fold_of(_req("topk", k=5)).k == 5
+        assert isinstance(fold_of(_req("fmatch", threshold=9)), FirstMatch)
+        # malformed params and unknown workloads resolve to None (the
+        # coordinator's Refuse path), never raise on the serve loop
+        req = _req()
+        object.__setattr__(req, "data", b"garbage")
+        assert fold_of(req) is None
+        object.__setattr__(req, "workload", "no-such")
+        assert fold_of(req) is None
+
+
+# ---------------------------------------------------------------------------
+# chunk-partial codecs: one frame per discipline, CRC load-bearing
+# ---------------------------------------------------------------------------
+
+class TestFoldCodecs:
+    ACCS = {
+        "fmin": [None, [5, 12]],
+        "topk": [[], [[3, 7]], [[1, 4], [1, 9], [2, 0]]],
+        "fmatch": [None, [None, None, 64], [12, 3, 13]],
+        "fsum": [[0, 0], [123456789, 42]],
+    }
+
+    def test_roundtrip_per_fold(self):
+        for fold in ALL_FOLDS:
+            for acc in self.ACCS[fold.name]:
+                got = fold.decode(fold.encode(acc))
+                want = acc
+                if fold.name == "fmatch" and acc == [None, None, 0]:
+                    want = None
+                assert got == want, (fold.name, acc)
+
+    def test_single_byte_corruption_per_fold(self):
+        for fold in ALL_FOLDS:
+            wire = fold.encode(self.ACCS[fold.name][-1])
+            for pos in range(len(wire)):
+                bad = bytearray(wire)
+                bad[pos] ^= 0xFF
+                with pytest.raises(ValueError):
+                    fold.decode(bytes(bad))
+
+    def test_cross_fold_payloads_never_misparse(self):
+        # distinct tags: one discipline's frame is a loud error to
+        # every other (lengths differ too, the checker's second key)
+        for a in ALL_FOLDS:
+            wire = a.encode(self.ACCS[a.name][-1])
+            for b in ALL_FOLDS:
+                if b.name == a.name:
+                    continue
+                with pytest.raises(ValueError):
+                    b.decode(wire)
+
+    def test_encode_rejects_out_of_range(self):
+        with pytest.raises(ValueError):
+            FMin().encode([1 << 64, 0])
+        with pytest.raises(ValueError):
+            TopK(2).encode([[0, 1 << 64]])
+        with pytest.raises(ValueError):
+            FirstMatch(0).encode([1, 1 << 64, 1])
+        with pytest.raises(ValueError):
+            FSum().encode([1 << 128, 1])
+
+    def test_topk_rejects_overfull_claims(self):
+        over = [[v, v] for v in range(folds.TOPK_SLOTS + 1)]
+        with pytest.raises(ValueError):
+            TopK(folds.TOPK_SLOTS).encode(over)
+        wire = bytearray(TopK(2).encode([[1, 2], [3, 4]]))
+        wire[1] = folds.TOPK_SLOTS + 1  # forged count
+        import zlib as _zlib
+        body = bytes(wire[:-4])
+        wire[-4:] = folds._CRC.pack(_zlib.crc32(body))
+        with pytest.raises(ValueError, match="count"):
+            TopK(2).decode(bytes(wire))
+
+
+# ---------------------------------------------------------------------------
+# fold semantics
+# ---------------------------------------------------------------------------
+
+class TestFoldSemantics:
+    def test_fmin_ties_break_to_the_lowest_index(self):
+        f = FMin()
+        assert f.combine([5, 9], [5, 3]) == [5, 3]
+        assert f.combine(None, [5, 3]) == [5, 3]
+        assert f.combine([4, 9], [5, 3]) == [4, 9]
+        assert f.of_batch(10, [7, 3, 3, 8]) == [3, 11]
+
+    def test_topk_is_globally_ordered_with_low_index_ties(self):
+        f = TopK(3)
+        a = f.of_batch(0, [5, 2, 5])     # [[2,1],[5,0],[5,2]]
+        b = f.of_batch(10, [2, 5, 1])    # [[1,12],[2,10],[5,11]]
+        assert f.combine(a, b) == [[1, 12], [2, 1], [2, 10]]
+        # commutative: same answer either way
+        assert f.combine(b, a) == f.combine(a, b)
+        with pytest.raises(ValueError):
+            TopK(0)
+
+    def test_topk_dedups_a_replayed_index(self):
+        f = TopK(2)
+        assert f.combine([[3, 7]], [[3, 7]]) == [[3, 7]]
+
+    def test_fmatch_probes_account_exactly(self):
+        f = FirstMatch(10)
+        assert f.of_batch(100, [50, 9, 70]) == [101, 9, 2]
+        assert f.of_batch(100, [50, 60, 70]) == [None, None, 3]
+        assert f.of_batch(100, []) is None
+        # dry prefix + hit: probes accumulate to index - lo + 1
+        dry = f.of_batch(0, [99] * 40)
+        hit = f.of_batch(40, [99, 4])
+        assert f.combine(dry, hit) == [41, 4, 42]
+        # two hits keep the earliest index but ALL the probes
+        assert f.combine([5, 1, 6], [50, 2, 51]) == [5, 1, 57]
+        assert f.is_final([5, 1, 6]) and not f.is_final([None, None, 6])
+
+    def test_fsum_is_a_plain_monoid(self):
+        f = FSum()
+        assert f.combine([3, 2], [5, 1]) == [8, 3]
+        assert f.combine(None, [5, 1]) == [5, 1]
+        assert f.of_batch(0, [1, 2, 3]) == [6, 3]
+        assert not f.idempotent
+
+    def test_every_fold_matches_a_direct_scan(self):
+        seed, lo, hi = 11, 0, 499
+        values = _vals(seed, lo, hi)
+        pairs = sorted([v, lo + i] for i, v in enumerate(values))
+        for fold, want in (
+            (FMin(), list(pairs[0])),
+            (TopK(3), [list(p) for p in pairs[:3]]),
+            (FSum(), [sum(values), len(values)]),
+        ):
+            acc = fold.initial()
+            for at in range(lo, hi + 1, 64):
+                end = min(hi, at + 63)
+                acc = fold.combine(
+                    acc, fold.of_batch(at, values[at - lo:end - lo + 1])
+                )
+            assert acc == want, fold.name
+
+
+# ---------------------------------------------------------------------------
+# the coverage gate: exactly-once for non-idempotent folds
+# ---------------------------------------------------------------------------
+
+class TestCoverageGate:
+    def test_absorb_refuses_any_overlap(self):
+        f = FSum()
+        st = new_state(f)
+        assert absorb(f, st, 0, 9, [10, 10])
+        assert not absorb(f, st, 0, 9, [10, 10])     # exact replay
+        assert not absorb(f, st, 5, 14, [10, 10])    # partial overlap
+        assert not absorb(f, st, 9, 9, [1, 1])       # edge touch
+        assert not absorb(f, st, 5, 4, [0, 0])       # inverted range
+        assert absorb(f, st, 10, 19, [7, 10])
+        assert st["acc"] == [17, 20]
+        assert st["covered"] == [[0, 19]]            # coalesced
+
+    def test_double_replay_is_a_structural_noop(self):
+        # the journal's replay path: same settle stream twice, any fold
+        for fold in ALL_FOLDS:
+            settles = [
+                (0, 9, fold.of_batch(0, _vals(3, 0, 9))),
+                (10, 19, fold.of_batch(10, _vals(3, 10, 19))),
+            ]
+            once = new_state(fold)
+            for lo, hi, acc in settles:
+                absorb(fold, once, lo, hi, acc)
+            twice = new_state(fold)
+            for lo, hi, acc in settles + settles:
+                absorb(fold, twice, lo, hi, acc)
+            assert once == twice, fold.name
+
+    def test_absorb_payload_skips_garbage_and_duplicates(self):
+        req = _req("fsum", seed=3, lo=0, hi=19)
+        fold = fold_of(req)
+        wp = fold.encode([100, 10])
+        st, ok = absorb_payload(req, None, 0, 9, wp)
+        assert ok and st["acc"] == [100, 10]
+        st2, ok = absorb_payload(req, st, 0, 9, wp)
+        assert not ok and st2 is st and st["acc"] == [100, 10]
+        st3, ok = absorb_payload(req, st, 10, 19, wp[:-1])
+        assert not ok and st3["acc"] == [100, 10]
+
+
+# ---------------------------------------------------------------------------
+# merge_states: independent WAL segments
+# ---------------------------------------------------------------------------
+
+class TestMergeStates:
+    def _state(self, fold, spans, seed=3):
+        st = new_state(fold)
+        for lo, hi in spans:
+            absorb(fold, st, lo, hi, fold.of_batch(lo, _vals(seed, lo, hi)))
+        return st
+
+    def test_disjoint_segments_combine_for_every_fold(self):
+        for fold in ALL_FOLDS:
+            a = self._state(fold, [(0, 9)])
+            b = self._state(fold, [(10, 19)])
+            whole = self._state(fold, [(0, 9), (10, 19)])
+            assert merge_states(fold, a, b) == whole, fold.name
+
+    def test_overlapping_sum_keeps_the_larger_coverage(self):
+        f = FSum()
+        a = self._state(f, [(0, 19)])
+        b = self._state(f, [(10, 29), (40, 44)])
+        merged = merge_states(f, a, b)
+        assert merged == b                       # 25 indices beats 20
+        assert merge_states(f, b, a) == b        # symmetric
+
+    def test_overlapping_idempotent_folds_still_combine(self):
+        f = FMin()
+        a = self._state(f, [(0, 19)])
+        b = self._state(f, [(10, 29)])
+        merged = merge_states(f, a, b)
+        assert merged["covered"] == [[0, 29]]
+        assert merged["acc"] == self._state(f, [(0, 29)])["acc"]
+
+    def test_empty_and_none_edges(self):
+        f = FSum()
+        a = self._state(f, [(0, 9)])
+        assert merge_states(f, None, a) == a
+        assert merge_states(f, a, None) == a
+        assert merge_states(f, new_state(f), a) == a
+        assert merge_states(f, None, None) is None
+
+
+# ---------------------------------------------------------------------------
+# verify_claim: the off-loop trust model, per variant
+# ---------------------------------------------------------------------------
+
+class TestVerifyClaim:
+    def _result(self, req, acc):
+        fold = fold_of(req)
+        return WorkResult(
+            job_id=req.job_id, chunk_id=req.chunk_id,
+            wid=hc.HASHCORE_WID, searched=req.upper - req.lower + 1,
+            payload=fold.encode(acc),
+        )
+
+    def test_honest_claims_verify(self):
+        seed, lo, hi = 21, 64, 191
+        values = _vals(seed, lo, hi)
+        pairs = sorted([v, lo + i] for i, v in enumerate(values))
+        lo_v, lo_i = pairs[0]
+        cases = [
+            (_req("fmin", seed, lo=lo, hi=hi), [lo_v, lo_i]),
+            (_req("topk", seed, k=3, lo=lo, hi=hi),
+             [list(p) for p in pairs[:3]]),
+            (_req("fmatch", seed, threshold=lo_v, lo=lo, hi=hi),
+             [lo_i, lo_v, lo_i - lo + 1]),
+            (_req("fmatch", seed, threshold=0, lo=lo, hi=hi),
+             [None, None, hi - lo + 1]),
+            (_req("fsum", seed, lo=lo, hi=hi),
+             [sum(values), len(values)]),
+        ]
+        for req, acc in cases:
+            assert workloads.verify_claim(req, self._result(req, acc)), acc
+
+    def test_byzantine_claims_are_rejected(self):
+        seed, lo, hi = 21, 64, 191
+        values = _vals(seed, lo, hi)
+        pairs = sorted([v, lo + i] for i, v in enumerate(values))
+        lo_v, lo_i = pairs[0]
+        cases = [
+            # wrong value for the witness index
+            (_req("fmin", seed, lo=lo, hi=hi), [lo_v ^ 1, lo_i]),
+            # witness outside the chunk range
+            (_req("fmin", seed, lo=lo, hi=hi),
+             [hc.objective(seed, hi + 1), hi + 1]),
+            # right pairs, wrong cardinality
+            (_req("topk", seed, k=3, lo=lo, hi=hi),
+             [list(p) for p in pairs[:2]]),
+            # unordered claim
+            (_req("topk", seed, k=2, lo=lo, hi=hi),
+             [list(pairs[1]), list(pairs[0])]),
+            # probes don't account for the dry prefix
+            (_req("fmatch", seed, threshold=lo_v, lo=lo, hi=hi),
+             [lo_i, lo_v, 1 if lo_i != lo else 2]),
+            # "nothing here" hiding a real match: rescan catches it
+            (_req("fmatch", seed, threshold=lo_v, lo=lo, hi=hi),
+             [None, None, hi - lo + 1]),
+            # a later match claimed as first
+            (_req("fmatch", seed, threshold=pairs[1][0], lo=lo, hi=hi),
+             [pairs[1][1], pairs[1][0], pairs[1][1] - lo + 1]
+             if pairs[1][1] > lo_i else None),
+            # off-by-one total
+            (_req("fsum", seed, lo=lo, hi=hi),
+             [sum(values) + 1, len(values)]),
+            # short count
+            (_req("fsum", seed, lo=lo, hi=hi),
+             [sum(values), len(values) - 1]),
+        ]
+        for req, acc in cases:
+            if acc is None:
+                continue
+            assert not workloads.verify_claim(
+                req, self._result(req, acc)
+            ), acc
+
+    def test_wid_and_payload_gates(self):
+        req = _req("fmin", seed=21, lo=0, hi=9)
+        good = self._result(req, [min(_vals(21, 0, 9)), 0])
+        wrong_wid = WorkResult(
+            job_id=good.job_id, chunk_id=good.chunk_id, wid=200,
+            searched=good.searched, payload=good.payload,
+        )
+        assert not workloads.verify_claim(req, wrong_wid)
+        torn = WorkResult(
+            job_id=good.job_id, chunk_id=good.chunk_id,
+            wid=good.wid, searched=good.searched,
+            payload=good.payload[:-1],
+        )
+        assert not workloads.verify_claim(req, torn)
+
+
+# ---------------------------------------------------------------------------
+# the worker compute seam
+# ---------------------------------------------------------------------------
+
+class TestComputeSeam:
+    def _drive(self, req, engine="cpu"):
+        yields = 0
+        for msg in workloads.compute(req, engine=engine):
+            if msg is None:
+                yields += 1
+                continue
+            return yields, msg
+        raise AssertionError("generator ended without a WorkResult")
+
+    def test_compute_yields_cooperatively_and_folds_exactly(self):
+        seed, hi = 5, 3 * 2048 + 100   # several _BATCH steps
+        req = _req("fmin", seed=seed, lo=0, hi=hi)
+        yields, msg = self._drive(req)
+        assert yields >= 3             # one heartbeat per batch
+        assert msg.searched == hi + 1
+        assert msg.wid == hc.HASHCORE_WID
+        values = _vals(seed, 0, hi)
+        v = min(values)
+        assert fold_of(req).decode(msg.payload) == [v, values.index(v)]
+        assert workloads.verify_claim(req, msg)
+
+    def test_engines_agree_bit_exactly(self):
+        req = _req("fsum", seed=9, lo=100, hi=4200)
+        _, cpu = self._drive(req, engine="cpu")
+        _, vec = self._drive(req, engine="jax")
+        assert cpu.payload == vec.payload
+
+    def test_first_match_stops_early(self):
+        seed, hi = 5, 200_000
+        # a threshold high enough that some early index clears it
+        req = _req("fmatch", seed=seed, threshold=(1 << 64) // 16, hi=hi)
+        _, msg = self._drive(req)
+        acc = fold_of(req).decode(msg.payload)
+        assert acc[0] is not None
+        assert msg.searched < hi + 1   # the cancel mirror: no full scan
+        assert workloads.verify_claim(
+            Request(
+                job_id=req.job_id, mode=PowMode.MIN, lower=0,
+                upper=msg.searched - 1, data=req.data,
+                chunk_id=req.chunk_id, workload="hashcore",
+            ),
+            msg,
+        )
+
+
+# ---------------------------------------------------------------------------
+# deterministic mirrors of the hypothesis fold properties
+# (tests/test_properties.py runs them under hypothesis where available)
+# ---------------------------------------------------------------------------
+
+def _random_partition(rng, lo, hi):
+    cuts = sorted(rng.sample(range(lo + 1, hi + 1),
+                             rng.randint(0, min(8, hi - lo))))
+    spans, at = [], lo
+    for c in cuts + [hi + 1]:
+        spans.append((at, c - 1))
+        at = c
+    return spans
+
+
+def test_mirror_chunk_order_never_changes_the_answer():
+    """Any partition of the range, absorbed in any order, with any
+    duplicates injected, lands on the same fold state — the property
+    that makes replay + out-of-order settles + WAL merges safe."""
+    rng = random.Random(0xF01D)
+    for trial in range(25):
+        seed = rng.randrange(1 << 32)
+        lo, hi = 0, rng.randint(10, 300)
+        spans = _random_partition(rng, lo, hi)
+        for fold in ALL_FOLDS:
+            settles = [
+                (a, b, fold.of_batch(a, _vals(seed, a, b)))
+                for a, b in spans
+            ]
+            baseline = new_state(fold)
+            for a, b, acc in settles:
+                assert absorb(fold, baseline, a, b, acc)
+            shuffled = settles[:]
+            rng.shuffle(shuffled)
+            # inject duplicate deliveries at random points
+            for dup in rng.sample(settles, min(2, len(settles))):
+                shuffled.insert(rng.randint(0, len(shuffled)), dup)
+            state = new_state(fold)
+            for a, b, acc in shuffled:
+                absorb(fold, state, a, b, acc)
+            assert state == baseline, (fold.name, trial)
+
+
+def test_mirror_beacon_prefix_splits_settle_exactly():
+    """ISSUE 14's beacon shape on the workload plane: a chunk settled
+    as a prefix beacon + its remainder folds to the same state as the
+    whole chunk at once, and replaying the beacon afterwards is a
+    no-op — sub-chunk progress is safe for every discipline, including
+    the non-idempotent sum."""
+    rng = random.Random(0xBEAC)
+    for trial in range(25):
+        seed = rng.randrange(1 << 32)
+        lo, hi = 0, rng.randint(20, 200)
+        cut = rng.randint(lo, hi - 1)
+        for fold in ALL_FOLDS:
+            whole = new_state(fold)
+            assert absorb(
+                fold, whole, lo, hi, fold.of_batch(lo, _vals(seed, lo, hi))
+            )
+            beacon = fold.of_batch(lo, _vals(seed, lo, cut))
+            rest = fold.of_batch(cut + 1, _vals(seed, cut + 1, hi))
+            split = new_state(fold)
+            assert absorb(fold, split, lo, cut, beacon)
+            assert absorb(fold, split, cut + 1, hi, rest)
+            assert not absorb(fold, split, lo, cut, beacon)  # replay
+            assert split["covered"] == whole["covered"]
+            if fold.name == "fmatch":
+                # probes under early-cancel are schedule-relative; the
+                # decided (index, value) is what must agree
+                assert split["acc"][:2] == whole["acc"][:2]
+            else:
+                assert split["acc"] == whole["acc"], fold.name
+
+
+def test_mirror_codec_roundtrip_under_random_accs():
+    rng = random.Random(0xC0DEC)
+    for _ in range(200):
+        v = rng.randrange(1 << 64)
+        i = rng.randrange(1 << 64)
+        n = rng.randint(0, folds.TOPK_SLOTS)
+        accs = [
+            (FMin(), [v, i]),
+            (TopK(folds.TOPK_SLOTS),
+             sorted([rng.randrange(1 << 64), k] for k in range(n))),
+            (FirstMatch(0), [i, v, rng.randrange(1, 1 << 64)]),
+            (FSum(), [rng.randrange(1 << 128), rng.randrange(1 << 64)]),
+        ]
+        for fold, acc in accs:
+            assert fold.decode(fold.encode(acc)) == acc
+
+
+# ---------------------------------------------------------------------------
+# the fleet drill gate (tier-1): loadgen --scenario workload --smoke
+# ---------------------------------------------------------------------------
+
+def test_loadgen_workload_scenario_smoke(capsys):
+    """All four disciplines through a REAL fleet — CpuMiners over LSP,
+    a worker kill, then a kill -9 coordinator crash and a journal
+    restart — with an exact-answer-per-fold exactly-once ledger: every
+    decoded answer checked against ground truth, zero wrong, zero
+    duplicated, zero lost, zero fail-fast refusals."""
+    rc = loadgen.main([
+        "--scenario", "workload", "--duration", "1.5",
+        "--smoke", "--json",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, f"workload gate failed: {out}"
+    metrics = _json.loads(out.splitlines()[0])
+    assert metrics["answered"] > 0
+    assert metrics["answers_wrong"] == 0
+    assert metrics["answers_duplicated"] == 0
+    assert metrics["answers_lost"] == 0
+    assert metrics["refused_fatal"] == 0
+    assert metrics["restart_to_first_assign_ms"] < 10_000
+    assert metrics["journal"]["records"] > 0
+    for fold in ("fmin", "topk", "fmatch_hit", "fmatch_dry", "fsum"):
+        assert metrics["answered_by_fold"].get(fold, 0) > 0, fold

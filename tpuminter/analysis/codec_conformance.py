@@ -225,6 +225,30 @@ def extract_kinds(src: ModuleSource) -> List[dict]:
     return kinds
 
 
+def extract_wids(src: ModuleSource) -> List[dict]:
+    """``*_WID`` integer constants — the workload-id namespace carried
+    on binary WorkResult frames (``tpuminter/workloads``). Like codec
+    tags, workload ids are one process-wide namespace: a collision
+    makes a recovered winner decode under the wrong workload."""
+    wids: List[dict] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        if not target.id.upper().endswith("_WID"):
+            continue
+        if isinstance(node.value, ast.Constant) and isinstance(
+            node.value.value, int
+        ) and not isinstance(node.value.value, bool):
+            wids.append({
+                "name": target.id, "module": src.path,
+                "line": node.lineno, "wid": node.value.value,
+            })
+    return wids
+
+
 def _u64_guard_findings(src: ModuleSource) -> List[Finding]:
     """Functions that ``.pack`` a Q-bearing layout must range-check
     against ``_U64`` / ``_U256`` first."""
@@ -312,5 +336,30 @@ def check_project(modules: Sequence[ModuleSource]) -> List[Finding]:
                     f"tag 0x{tag:02X} is claimed in multiple modules "
                     f"({names}) — WAL shipping puts journal and wire "
                     f"records in one byte namespace",
+                ))
+    # workload-id namespace (ISSUE 15): every registered workload's
+    # ``*_WID`` must be process-unique — it is the dispatch key on
+    # WorkResult frames and in recovered winner records
+    by_wid: Dict[int, List[dict]] = {}
+    for src in modules:
+        for wid in extract_wids(src):
+            by_wid.setdefault(wid["wid"], []).append(wid)
+    for value, group in sorted(by_wid.items()):
+        if len(group) > 1:
+            names = ", ".join(
+                f"{w['module']}:{w['name']}" for w in sorted(
+                    group, key=lambda w: (w["module"], w["name"])
+                )
+            )
+            for wid in sorted(
+                group, key=lambda w: (w["module"], w.get("line", 0))
+            )[1:]:
+                findings.append(Finding(
+                    CHECKER, wid["module"], wid["line"], "",
+                    f"workload-id-collision:{wid['name']}",
+                    f"workload id {value} is claimed more than once "
+                    f"({names}) — WorkResult frames and recovered "
+                    f"winners dispatch on the wid; a collision decodes "
+                    f"a winner under the wrong workload",
                 ))
     return findings

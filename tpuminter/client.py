@@ -30,13 +30,20 @@ from tpuminter.protocol import (
     Refuse,
     Request,
     Result,
+    WorkResult,
     decode_msg,
     encode_msg,
 )
 
-__all__ = ["submit", "main"]
+__all__ = ["JobRefused", "submit", "main"]
 
 log = logging.getLogger("tpuminter.client")
+
+
+class JobRefused(Exception):
+    """The coordinator refused the submission with no retry hint — a
+    malformed request (unknown workload, params its codec rejects), not
+    backpressure. Retrying verbatim would loop forever."""
 
 
 async def submit(
@@ -98,7 +105,10 @@ async def submit(
             client.write(encode_msg(request))
             while True:
                 msg = decode_msg(await client.read())
-                if isinstance(msg, Result) and msg.job_id == request.job_id:
+                if (
+                    isinstance(msg, (Result, WorkResult))
+                    and msg.job_id == request.job_id
+                ):
                     return msg
                 if (
                     isinstance(msg, Refuse)
@@ -122,6 +132,16 @@ async def submit(
                     await asyncio.sleep(wait)
                     client.write(encode_msg(request))
                     continue
+                if (
+                    isinstance(msg, Refuse)
+                    and msg.retry_after_ms <= 0
+                    and msg.job_id == request.job_id
+                ):
+                    # no retry hint: the request itself is bad (unknown
+                    # workload / malformed params) — fail fast
+                    raise JobRefused(
+                        f"coordinator refused job {request.job_id}"
+                    )
                 log.warning(
                     "client: ignoring unexpected %s", type(msg).__name__
                 )
@@ -199,6 +219,24 @@ def main(argv: Optional[list] = None) -> None:
                         "deduplication (default: random per invocation; "
                         "pass a stable key to dedup across client-process "
                         "restarts too)")
+    parser.add_argument("--workload", metavar="NAME", default=None,
+                        help="submit a registered-workload job (ISSUE 15) "
+                        "over [0, --max-nonce] instead of a mining job; "
+                        "e.g. 'hashcore' with the --variant/--seed/"
+                        "--threshold/--k params below")
+    parser.add_argument("--variant", default="fmin",
+                        choices=("fmin", "topk", "fmatch", "fsum"),
+                        help="hashcore fold variant (default fmin)")
+    parser.add_argument("--seed", type=lambda s: int(s, 0), default=1,
+                        help="hashcore objective seed (default 1)")
+    parser.add_argument("--threshold", type=lambda s: int(s, 0), default=0,
+                        help="hashcore fmatch threshold")
+    parser.add_argument("--k", type=int, default=4,
+                        help="hashcore topk k, 1-8 (default 4)")
+    parser.add_argument("--params", metavar="HEX", default=None,
+                        help="with --workload: raw params frame bytes "
+                        "(overrides the hashcore convenience flags — the "
+                        "escape hatch for other registered workloads)")
     args = parser.parse_args(argv)
     if args.timeout is not None and args.timeout <= 0:
         parser.error("--timeout must be positive seconds")
@@ -240,7 +278,34 @@ def main(argv: Optional[list] = None) -> None:
         except ValueError:
             parser.error(f"{what} is not valid hex: {value!r}")
 
-    if args.header is not None:
+    if args.workload is not None:
+        if args.header is not None:
+            parser.error("--workload conflicts with --header")
+        if args.params is not None:
+            data = _hex(args.params, "--params")
+        elif args.workload == "hashcore":
+            from tpuminter.workloads import hashcore as _hc
+
+            try:
+                data = _hc.pack_params(
+                    args.variant, args.seed, args.threshold, args.k
+                )
+            except ValueError as exc:
+                parser.error(str(exc))
+        else:
+            parser.error(
+                f"--workload {args.workload}: pass --params HEX (only "
+                "hashcore's params have convenience flags)"
+            )
+        request = Request(
+            job_id=1,
+            mode=PowMode.MIN,
+            lower=0,
+            upper=args.max_nonce_opt,
+            data=data,
+            workload=args.workload,
+        )
+    elif args.header is not None:
         header = _hex(args.header, "--header")
         rolled = {}
         upper = args.max_nonce_opt
@@ -313,6 +378,30 @@ def main(argv: Optional[list] = None) -> None:
             return 1
         except LspConnectionLost:
             print("Disconnected")
+            return 0
+        except JobRefused:
+            print("Refused (unknown workload or malformed params)")
+            return 1
+        if isinstance(result, WorkResult):
+            # fold-aware rendering: top-k and map-reduce answers print
+            # their full payload via the discipline's describe()
+            from tpuminter import workloads
+
+            fold = workloads.fold_of(request)
+            payload = bytes(result.payload)
+            if fold is None:
+                print(f"Result [{request.workload}] payload={payload.hex()}")
+            else:
+                try:
+                    acc = fold.decode(payload)
+                except ValueError:
+                    print(
+                        f"Result [{request.workload}] undecodable "
+                        f"payload={payload.hex()}"
+                    )
+                    return 1
+                print(f"Result [{request.workload}] {fold.describe(acc)}")
+            print(f"  searched={result.searched}")
             return 0
         if request.mode == PowMode.MIN:
             print(f"Result {result.hash_value} {result.nonce}")

@@ -43,6 +43,7 @@ from dataclasses import replace as dc_replace
 from typing import Deque, Dict, List, Optional, Tuple
 
 from tpuminter import chain
+from tpuminter import workloads
 from tpuminter.analysis import affinity
 from tpuminter.journal import (
     WINNERS_CAP,
@@ -67,6 +68,7 @@ from tpuminter.protocol import (
     Result,
     RollAssign,
     Setup,
+    WorkResult,
     decode_msg,
     encode_msg,
     request_to_obj,
@@ -210,6 +212,11 @@ class _MinerState:
     #: it reports sub-chunk progress Beacons (ISSUE 14). Old peers
     #: never see either — no flag day, same discipline as ``binary``.
     roll: bool = False
+    #: pluggable workload names this worker's registry advertised in
+    #: its Join (ISSUE 15). A workload job is only ever dispatched —
+    #: primary or hedge — to a miner whose set contains it; mining jobs
+    #: ("" workload) go anywhere. Same no-flag-day shape as ``roll``.
+    workloads: frozenset = frozenset()
     #: outstanding dispatches, oldest first:
     #: chunk_id → (job_id, lower, upper, dispatched_at). The chunk_id
     #: lets a Result be matched to the exact dispatch it answers: after
@@ -234,6 +241,11 @@ class _MinerState:
     def has_capacity(self) -> bool:
         """True while the dispatch pipeline has room for another chunk."""
         return len(self.chunks) < self.depth
+
+    def supports(self, workload: str) -> bool:
+        """Can this miner compute ``workload``? ("" = classic mining,
+        which every miner speaks.)"""
+        return not workload or workload in self.workloads
 
     def snapshot(self) -> dict:
         """Rate/liveness view for :meth:`Coordinator.worker_stats`."""
@@ -318,10 +330,34 @@ class _Job:
     #: monotonic instant the owning durable client was last lost (0 =
     #: currently bound); the UNBOUND-residue reaper's clock
     unbound_since: float = 0.0
+    #: pluggable workload (ISSUE 15): the registered fold discipline
+    #: this job reduces under (None = classic min-fold mining) and its
+    #: coverage-gated fold state. ``discipline`` (not ``fold`` — that
+    #: name is the mining method below) is resolved once at _on_request
+    #: / _adopt; past that point the coordinator only calls the generic
+    #: Fold interface, never anything workload-specific.
+    discipline: Optional[workloads.Fold] = None
+    wstate: Optional[dict] = None
+
+    @property
+    def workload(self) -> str:
+        return self.request.workload
 
     def fold(self, hash_value: int, nonce: int) -> None:
         if self.best is None or (hash_value, nonce) < self.best:
             self.best = (hash_value, nonce)
+
+    def wfold(self, lo: int, hi: int, acc) -> bool:
+        """Coverage-gated workload fold (see tpuminter.workloads)."""
+        if self.wstate is None:
+            self.wstate = workloads.new_state(self.discipline)
+        return workloads.absorb(self.discipline, self.wstate, lo, hi, acc)
+
+    @property
+    def wacc(self):
+        if self.wstate is None:
+            self.wstate = workloads.new_state(self.discipline)
+        return self.wstate["acc"]
 
     @property
     def exhausted(self) -> bool:
@@ -585,6 +621,9 @@ class Coordinator:
             #: admission control (ISSUE 13): submissions answered with
             #: Refuse{retry_after_ms} instead of a job
             "refused_admission": 0,
+            #: Requests naming an unregistered workload or carrying
+            #: params their workload's codec rejects (ISSUE 15)
+            "refused_workload": 0,
             #: zero-progress pending jobs LRU-shed back to Refuse to
             #: make room under --max-jobs
             "jobs_shed": 0,
@@ -713,15 +752,21 @@ class Coordinator:
                 continue
             # replayed winners are durable by construction: they came
             # off the fsynced record stream
-            self._winners[(ckey, cjid)] = _Winner(
-                Result(
+            if "wp" in rec:
+                # workload winner (ISSUE 15): the acknowledged answer is
+                # the fold payload itself, re-delivered as a WorkResult
+                res = WorkResult(
+                    job_id=cjid, chunk_id=0, wid=int(rec.get("wid", 0)),
+                    searched=int(rec["s"]),
+                    payload=bytes.fromhex(rec["wp"]),
+                )
+            else:
+                res = Result(
                     cjid, PowMode(rec["mode"]), int(rec["n"]),
                     int(rec["h"], 16), bool(rec["found"]),
                     searched=int(rec["s"]),
-                ),
-                durable=True,
-                ts=ts,
-            )
+                )
+            self._winners[(ckey, cjid)] = _Winner(res, durable=True, ts=ts)
         self._trim_winners()
         finish_now = []
         for rjob in recovered.jobs.values():
@@ -734,6 +779,20 @@ class Coordinator:
             job.ranges.extend(rjob.remaining)
             job.best = rjob.best
             job.hashes_done = rjob.hashes_done
+            if rjob.request.workload:
+                job.discipline = workloads.fold_of(rjob.request)
+                if job.discipline is None:
+                    # the journal outlived the registry (a workload this
+                    # build no longer ships): adopting the job would
+                    # wedge — drop it loudly; the client's re-submit
+                    # gets a clean Refuse instead
+                    log.warning(
+                        "dropping recovered job %d: workload %r is not "
+                        "registered in this build",
+                        rjob.job_id, rjob.request.workload,
+                    )
+                    continue
+                job.wstate = rjob.wstate
             self._jobs[job.job_id] = job
             if self._unbound_ttl:
                 # a recovered job is UNBOUND until its client
@@ -748,7 +807,14 @@ class Coordinator:
                 )
             if job.ranges:
                 self._rotation.append(job.job_id)
-            if (
+            if job.discipline is not None:
+                if job.discipline.is_final(job.wacc):
+                    # a settled first-match whose finish record was lost
+                    # to the crash: finish now, Cancel the rest
+                    finish_now.append((job, True))
+                elif job.exhausted:
+                    finish_now.append((job, None))
+            elif (
                 job.best is not None
                 and job.request.mode.targeted
                 and job.best[0] <= (job.request.target or 0)
@@ -783,6 +849,16 @@ class Coordinator:
         self, job: _Job, lo: int, hi: int, msg: Result, searched: int
     ) -> None:
         if self._journal is None:
+            return
+        if job.discipline is not None:
+            # workload settle (ISSUE 15): interval subtraction replays
+            # exactly like a mining settle, and the payload hex rides
+            # along so recovery re-absorbs the partial through the
+            # coverage gate (journal.RecoveredState's "wp" branch)
+            self._journal.append("settle", {
+                "id": job.job_id, "lo": lo, "hi": hi, "s": searched,
+                "wp": bytes(msg.payload).hex(),
+            })
             return
         # the journal's highest-rate record (one per accepted chunk):
         # the same struct-packed discipline as the wire's binary Result
@@ -820,7 +896,7 @@ class Coordinator:
                 + [(lo, hi) for _conn, lo, hi in job.inflight.values()]
                 + list(job.verifying)
             )
-            jobs.append({
+            rec = {
                 "id": job.job_id,
                 "req": request_to_obj(job.request),
                 "rem": [[lo, hi] for lo, hi in remaining],
@@ -829,21 +905,43 @@ class Coordinator:
                     else [f"{job.best[0]:x}", job.best[1]]
                 ),
                 "hashes": job.hashes_done,
-            })
+            }
+            if job.wstate is not None:
+                # workload fold state rides the checkpoint verbatim
+                # (plain JSON-able covered/acc) — replay resumes the
+                # fold exactly where the settles left it
+                rec["wst"] = job.wstate
+            jobs.append(rec)
         return {
             "k": "snapshot",
             "next": self._next_job_id,
             "jobs": jobs,
             "winners": [
-                [ck, cj, {
-                    "k": "finish", "id": 0, "ckey": ck, "cjid": cj,
-                    "mode": w.result.mode.value, "n": w.result.nonce,
-                    "h": f"{w.result.hash_value:x}",
-                    "found": w.result.found, "s": w.result.searched,
-                    "ts": w.ts,
-                }]
+                [ck, cj, self._winner_rec(ck, cj, w)]
                 for (ck, cj), w in self._winners.items()
             ],
+        }
+
+    @staticmethod
+    def _winner_rec(ck: str, cj: int, w: "_Winner") -> dict:
+        """One dedup-table entry as a replayable finish record (the
+        snapshot's winners list). Workload winners carry the fold
+        payload instead of the mining (nonce, hash) pair."""
+        if isinstance(w.result, WorkResult):
+            return {
+                "k": "finish", "id": 0, "ckey": ck, "cjid": cj,
+                "mode": PowMode.MIN.value, "n": 0, "h": "0",
+                "found": True, "s": w.result.searched,
+                "wid": w.result.wid,
+                "wp": bytes(w.result.payload).hex(),
+                "ts": w.ts,
+            }
+        return {
+            "k": "finish", "id": 0, "ckey": ck, "cjid": cj,
+            "mode": w.result.mode.value, "n": w.result.nonce,
+            "h": f"{w.result.hash_value:x}",
+            "found": w.result.found, "s": w.result.searched,
+            "ts": w.ts,
         }
 
     @property
@@ -951,7 +1049,7 @@ class Coordinator:
             )
             return
         # dispatch order mirrors steady-state frequency: Results dominate
-        if isinstance(msg, Result):
+        if isinstance(msg, (Result, WorkResult)):
             self._on_result(conn_id, msg)
         elif isinstance(msg, Beacon):
             self._on_beacon(conn_id, msg)
@@ -1157,14 +1255,20 @@ class Coordinator:
             # that advertised it ever receives a RollAssign (and only
             # RollAssign recipients emit Beacons — worker side)
             roll=msg.roll,
+            # pluggable workloads (ISSUE 15): only names this side's
+            # registry also knows — an id neither side can resolve must
+            # never route work
+            workloads=frozenset(msg.workloads) & set(workloads.names()),
         )
         self._miners[conn_id] = miner
         self._idle[conn_id] = miner
         log.info(
-            "miner %d joined (backend=%s, lanes=%d, span=%d, codec=%s%s)",
+            "miner %d joined (backend=%s, lanes=%d, span=%d, codec=%s%s%s)",
             conn_id, msg.backend, msg.lanes, msg.span,
             "bin" if miner.binary else "json",
             ", roll" if miner.roll else "",
+            (", workloads=" + ",".join(sorted(miner.workloads)))
+            if miner.workloads else "",
         )
         self._schedule_dispatch()
 
@@ -1454,6 +1558,23 @@ class Coordinator:
             )
             self._send_refuse(conn_id, msg.job_id, retry_ms)
             return
+        discipline = None
+        if msg.workload:
+            # resolve the fold discipline NOW (ISSUE 15): an unknown
+            # workload name or params the codec rejects is a malformed
+            # submission, not a capacity problem — Refuse with no
+            # retry hint so the client fails fast instead of backing
+            # off into the same error
+            discipline = workloads.fold_of(msg)
+            if discipline is None:
+                self.stats["refused_workload"] += 1
+                log.warning(
+                    "refused job %d from client %d: unknown workload "
+                    "%r or malformed params", msg.job_id, conn_id,
+                    msg.workload,
+                )
+                self._send_refuse(conn_id, msg.job_id, 0)
+                return
         job_id = self._next_job_id
         self._next_job_id += self._job_id_stride
         job = _Job(
@@ -1462,6 +1583,7 @@ class Coordinator:
             client_job_id=msg.job_id,
             request=msg,
         )
+        job.discipline = discipline
         job.ranges.append((msg.lower, msg.upper))
         self._jobs[job_id] = job
         self._clients.setdefault(conn_id, set()).add(job_id)
@@ -1476,8 +1598,9 @@ class Coordinator:
             "job", {"id": job_id, "req": request_to_obj(msg)}
         )
         log.info(
-            "client %d submitted job %d: mode=%s range=[%d, %d]",
+            "client %d submitted job %d: mode=%s range=[%d, %d]%s",
             conn_id, job_id, msg.mode.value, msg.lower, msg.upper,
+            f" workload={msg.workload}" if msg.workload else "",
         )
         self._schedule_dispatch()
 
@@ -1520,11 +1643,13 @@ class Coordinator:
         job = self._jobs.get(job_id)
         if job is not None and not job.done:
             job.inflight.pop(msg.chunk_id, None)
-            if job.request.mode == PowMode.SCRYPT:
+            if job.request.mode == PowMode.SCRYPT or job.discipline is not None:
                 # memory-hard verification (~hashlib.scrypt, ≥300 µs a
-                # call) must not run on the event loop: a fleet-wide
-                # result burst verifying inline would stall epoch
-                # heartbeats. Offload to the executor; the job stays
+                # call) must not run on the event loop — and neither
+                # may a workload verifier, whose recompute-grade proofs
+                # (first-match absence, sum) rescan whole chunks: a
+                # fleet-wide result burst verifying inline would stall
+                # epoch heartbeats. Offload to the executor; the job stays
                 # open (pending_verifications) until the claim settles,
                 # and the miner is already idle for its next chunk.
                 # Hedges settle NOW, not at accept: with both copies'
@@ -1629,6 +1754,11 @@ class Coordinator:
         req = job.request if job is not None else None
         if req is None:
             return
+        if job.discipline is not None:
+            # a workload verifier judges the claim against the CHUNK
+            # range it answers (prefix-dry proofs, exact counts) — not
+            # the whole job's span
+            req = dc_replace(req, lower=lo, upper=hi)
         try:
             ok = await asyncio.get_running_loop().run_in_executor(
                 None, self._verify_result, req, msg
@@ -1664,17 +1794,20 @@ class Coordinator:
                 )
             else:
                 # the prover died while we verified — its work is still
-                # good (the hash is real): fold it so nothing re-mines
+                # good (the claim verified): fold it so nothing re-mines
                 # the range, then let exhaustion settle
                 searched = msg.searched if msg.searched > 0 else hi - lo + 1
                 job.hashes_done += searched
                 self.stats["hashes"] += searched
-                job.fold(msg.hash_value, msg.nonce)
-                self._journal_settle(job, lo, hi, msg, searched)
-                if msg.found and job.request.mode.targeted:
-                    self._finish_job(job, found=True)
+                if job.discipline is not None:
+                    self._settle_work(job, msg, lo, hi, searched)
                 else:
-                    self._maybe_finish_exhausted(job)
+                    job.fold(msg.hash_value, msg.nonce)
+                    self._journal_settle(job, lo, hi, msg, searched)
+                    if msg.found and job.request.mode.targeted:
+                        self._finish_job(job, found=True)
+                    else:
+                        self._maybe_finish_exhausted(job)
         else:
             self._reject_result(conn_id, job, msg, lo, hi)
             self._maybe_finish_exhausted(job)
@@ -1717,6 +1850,12 @@ class Coordinator:
         miner.last_result = time.monotonic()
         if self._hedge_after is not None:
             self._settle_hedges(job, conn_id, lo, hi)
+        if job.discipline is not None:
+            # workload chunk (ISSUE 15): coverage-gated fold + settle.
+            # No audit sampling — the registered verifiers already did
+            # recompute-grade checks in the executor.
+            self._settle_work(job, msg, lo, hi, searched)
+            return
         job.fold(msg.hash_value, msg.nonce)
         self._journal_settle(job, lo, hi, msg, searched)
         if msg.found and job.request.mode.targeted:
@@ -1729,6 +1868,31 @@ class Coordinator:
                 self._enqueue_audit(job, conn_id, msg, lo, hi)
             self._maybe_finish_exhausted(job)
 
+    def _settle_work(
+        self, job: _Job, msg, lo: int, hi: int, searched: int
+    ) -> None:
+        """Book one verified workload chunk (ISSUE 15): decode the
+        partial, absorb it through the coverage gate (a duplicate
+        delivery — hedge loser, redial replay — is a structural no-op,
+        which is what keeps non-idempotent folds exactly-once), journal
+        the settle WITH the payload bytes so replay can re-absorb, and
+        finish when the discipline says so. ``is_final`` (first-match)
+        takes the same early-retire path a found mining job does —
+        Cancel broadcast included."""
+        try:
+            acc = job.discipline.decode(msg.payload)
+        except (ValueError, AttributeError):
+            # verify_claim decoded these bytes in the executor moments
+            # ago; only a torn buffer lands here — requeue, never corrupt
+            self._requeue_chunk(job, lo, hi)
+            return
+        if job.wfold(lo, hi, acc):
+            self._journal_settle(job, lo, hi, msg, searched)
+        if job.discipline.is_final(job.wacc):
+            self._finish_job(job, found=True)
+        else:
+            self._maybe_finish_exhausted(job)
+
     def _reject_result(
         self, conn_id: int, job: _Job, msg: Result, lo: int, hi: int
     ) -> None:
@@ -1739,7 +1903,7 @@ class Coordinator:
         log.warning(
             "miner %d returned an unverifiable result for job %d "
             "(nonce=%d); chunk [%d, %d] requeued",
-            conn_id, job.job_id, msg.nonce, lo, hi,
+            conn_id, job.job_id, getattr(msg, "nonce", -1), lo, hi,
         )
         # beacon-settled prefixes stay settled (each was independently
         # verified and journaled); only the residual [lo, hi] re-mines
@@ -1766,10 +1930,15 @@ class Coordinator:
         under-searcher requeues ranges, un-exhausting the job)."""
         if job.done or not job.exhausted:
             return
-        found = (
-            job.request.mode == PowMode.MIN
-            or job.best[0] <= (job.request.target or 0)
-        )
+        if job.discipline is not None:
+            # the discipline decides: a first-match job that exhausted
+            # dry reports found=False, a sum always reports found=True
+            found = job.discipline.found(job.wacc)
+        else:
+            found = (
+                job.request.mode == PowMode.MIN
+                or job.best[0] <= (job.request.target or 0)
+            )
         self._finish_job(job, found=found)
 
     def _on_refuse(self, conn_id: int, msg: Refuse) -> None:
@@ -2022,6 +2191,14 @@ class Coordinator:
         is the residual hole the sampled re-mine audits close
         (``_enqueue_audit``, opt-in via ``audit_rate``).
         """
+        if req.workload:
+            # registered-workload claims delegate wholesale (ISSUE 15):
+            # the workload's verifier checks the decoded partial against
+            # this chunk-Request's exact range. A mining-dialect Result
+            # answering a workload chunk fails the wid check inside.
+            return workloads.verify_claim(req, msg)
+        if not isinstance(msg, Result):
+            return False  # a WorkResult answering a mining chunk
         if not msg.found and msg.hash_value == MIN_UNTRACKED:
             # fast-path sentinel: "exhausted, no winner, min untracked".
             # Only the targeted dialects have a found flag to stand on —
@@ -2070,11 +2247,25 @@ class Coordinator:
 
     def _finish_job(self, job: _Job, *, found: bool) -> None:
         job.done = True
-        hash_value, nonce = job.best
-        result = Result(
-            job.client_job_id, job.request.mode, nonce, hash_value,
-            found, searched=job.hashes_done,
-        )
+        wpayload = b""
+        if job.discipline is not None:
+            # workload answer (ISSUE 15): the final fold accumulator
+            # rides a WorkResult — found lives in the payload semantics
+            # (a dry first-match encodes has=0), and the mining fields
+            # below are placeholders for the shared finish record shape
+            hash_value, nonce = 0, 0
+            wpayload = job.discipline.encode(job.wacc)
+            result = WorkResult(
+                job_id=job.client_job_id, chunk_id=0,
+                wid=workloads.get(job.workload).wid,
+                searched=job.hashes_done, payload=wpayload,
+            )
+        else:
+            hash_value, nonce = job.best
+            result = Result(
+                job.client_job_id, job.request.mode, nonce, hash_value,
+                found, searched=job.hashes_done,
+            )
         ckey = job.request.client_key
         winner: Optional[_Winner] = None
         if ckey:
@@ -2104,22 +2295,22 @@ class Coordinator:
                 on_durable = functools.partial(
                     self._gate_on_replicas, on_durable
                 )
-            self._journal.append(
-                "finish",
-                {
-                    "id": job.job_id, "ckey": ckey,
-                    "cjid": job.client_job_id,
-                    "mode": job.request.mode.value, "n": nonce,
-                    "h": f"{hash_value:x}", "found": found,
-                    "s": job.hashes_done,
-                    # wall-clock birth of the dedup entry: the age
-                    # bound must survive replay (winner is None when
-                    # the job has no ckey — then nothing entered the
-                    # table and the ts is moot)
-                    "ts": winner.ts if winner is not None else time.time(),
-                },
-                on_durable=on_durable,
-            )
+            rec = {
+                "id": job.job_id, "ckey": ckey,
+                "cjid": job.client_job_id,
+                "mode": job.request.mode.value, "n": nonce,
+                "h": f"{hash_value:x}", "found": found,
+                "s": job.hashes_done,
+                # wall-clock birth of the dedup entry: the age
+                # bound must survive replay (winner is None when
+                # the job has no ckey — then nothing entered the
+                # table and the ts is moot)
+                "ts": winner.ts if winner is not None else time.time(),
+            }
+            if job.discipline is not None:
+                rec["wid"] = workloads.get(job.workload).wid
+                rec["wp"] = wpayload.hex()
+            self._journal.append("finish", rec, on_durable=on_durable)
         else:
             self._deliver_finish(client_conn, result)
         elapsed = time.monotonic() - job.started
@@ -2282,13 +2473,26 @@ class Coordinator:
             elif auditor.has_capacity:
                 idle.append(auditor)  # pipeline not full: keep serving
         self._audit_queue.extendleft(reversed(held))
-        while idle and self._rotation:
+        skipped = 0
+        while idle and self._rotation and skipped < len(self._rotation):
             job_id = self._rotation[0]
             job = self._jobs.get(job_id)
             if job is None or job.done or not job.ranges:
                 self._rotation.popleft()
                 continue
-            miner = idle.popleft()
+            miner = next(
+                (m for m in idle if m.supports(job.workload)), None
+            )
+            if miner is None:
+                # nobody idle runs this job's workload (ISSUE 15):
+                # rotate past it — bounded by the rotation length so a
+                # fleet with no capable worker can't spin this pass —
+                # and let the jobs behind it dispatch
+                self._rotation.rotate(-1)
+                skipped += 1
+                continue
+            idle.remove(miner)
+            skipped = 0
             lo, hi = job.ranges.popleft()
             roll = self._roll_carve(miner, job, lo, hi)
             if roll is not None:
@@ -2461,6 +2665,7 @@ class Coordinator:
                 (
                     m for m in idle
                     if not m.busy and m.conn_id != straggler_conn
+                    and m.supports(job.workload)
                     and 4 * self._budget(m, job) >= size
                 ),
                 None,

@@ -367,6 +367,11 @@ class RecoveredJob:
     remaining: List[Tuple[int, int]]
     best: Optional[Tuple[int, int]] = None  # (hash_value, nonce) min-fold
     hashes_done: int = 0
+    #: pluggable-workload fold state (ISSUE 15):
+    #: ``{"covered": [[lo, hi], ...], "acc": ...}`` — rebuilt from
+    #: ``"wp"`` settle records via the registered discipline's
+    #: coverage-gated absorb; None for classic mining jobs
+    wstate: Optional[dict] = None
 
     @property
     def client_key(self) -> str:
@@ -377,13 +382,16 @@ class RecoveredJob:
         return self.request.job_id
 
     def to_obj(self) -> dict:
-        return {
+        obj = {
             "id": self.job_id,
             "req": request_to_obj(self.request),
             "rem": [[lo, hi] for lo, hi in self.remaining],
             "best": _best_to_obj(self.best),
             "hashes": self.hashes_done,
         }
+        if self.wstate is not None:
+            obj["wst"] = self.wstate
+        return obj
 
     @classmethod
     def from_obj(cls, obj: dict) -> "RecoveredJob":
@@ -395,6 +403,7 @@ class RecoveredJob:
             ),
             best=_best_from_obj(obj.get("best")),
             hashes_done=int(obj.get("hashes", 0)),
+            wstate=obj.get("wst"),
         )
 
 
@@ -457,6 +466,19 @@ class RecoveredState:
             )
             if removed:
                 job.hashes_done += int(rec["s"])
+            if "wp" in rec:
+                # pluggable-workload settle (ISSUE 15): absorb the fold
+                # payload through the registered discipline's
+                # COVERAGE-GATED fold — double replay of the same range
+                # is a structural no-op even for non-idempotent folds
+                # (sum), mirroring what subtract_range gives remaining
+                from tpuminter import workloads as _workloads
+
+                job.wstate, _ = _workloads.absorb_payload(
+                    job.request, job.wstate, int(rec["lo"]),
+                    int(rec["hi"]), bytes.fromhex(rec["wp"]),
+                )
+                return
             claim = (int(rec["h"], 16), int(rec["n"]))
             if job.best is None or claim < job.best:
                 job.best = claim  # min-fold: idempotent under replay
@@ -551,7 +573,7 @@ def merge_states(states: List[RecoveredState]) -> RecoveredState:
                 out.jobs[jid] = RecoveredJob(
                     job_id=job.job_id, request=job.request,
                     remaining=list(job.remaining), best=job.best,
-                    hashes_done=job.hashes_done,
+                    hashes_done=job.hashes_done, wstate=job.wstate,
                 )
                 continue
             cur.remaining = intersect_ranges(cur.remaining, job.remaining)
@@ -560,6 +582,18 @@ def merge_states(states: List[RecoveredState]) -> RecoveredState:
                 cur.best is None or job.best < cur.best
             ):
                 cur.best = job.best
+            if job.wstate is not None or cur.wstate is not None:
+                # workload fold states merge through the registered
+                # discipline (disjoint coverage combines; overlap on a
+                # non-idempotent fold keeps the larger-coverage state —
+                # the intersect-remaining rule above re-mines the rest)
+                from tpuminter import workloads as _workloads
+
+                fold = _workloads.fold_of(cur.request)
+                if fold is not None:
+                    cur.wstate = _workloads.merge_states(
+                        fold, cur.wstate, job.wstate
+                    )
         for key, w in st.winners.items():
             out.winners.pop(key, None)
             out.winners[key] = dict(w)

@@ -91,6 +91,7 @@ __all__ = [
     "Join",
     "Request",
     "Result",
+    "WorkResult",
     "Cancel",
     "Setup",
     "Assign",
@@ -167,6 +168,15 @@ class Join:
     when False and old decoders ignore it; the binary flag bit is one an
     old decoder never tests), and the coordinator only dispatches
     RollAssigns to workers that set it.
+
+    ``workloads`` advertises the pluggable workload names this worker's
+    registry (:mod:`tpuminter.workloads`) can compute — the same
+    no-flag-day contract again: a Join carrying any name encodes as
+    JSON (the binary Join layout predates the field and v1 layouts
+    never change meaning; one JSON Join per connection costs nothing),
+    the key is omitted when empty so old decoders ignore it, and the
+    coordinator only dispatches a workload job to workers that
+    advertised its name.
     """
 
     backend: str = "cpu"
@@ -174,6 +184,7 @@ class Join:
     span: int = 0
     codec: str = "json"
     roll: bool = False
+    workloads: Tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -209,6 +220,14 @@ class Request:
     still-running job instead of spawning a duplicate (see
     ``tpuminter.journal``). Empty (the default) opts out: anonymous
     jobs keep the reference's connection-scoped lifetime.
+
+    ``workload`` names a pluggable workload (:mod:`tpuminter.workloads`,
+    ISSUE 15): empty means classic mining; otherwise ``data`` carries
+    that workload's own tagged+CRC'd params frame, ``mode`` stays MIN
+    (the u64-range dialect — workload indices are plain u64s), and the
+    coordinator resolves the fold discipline, verifier, and compute
+    seam from the registry. Workload chunk answers travel as
+    :class:`WorkResult`, not :class:`Result`.
     """
 
     job_id: int
@@ -225,6 +244,7 @@ class Request:
     branch: Tuple[bytes, ...] = ()
     nonce_bits: int = 32
     client_key: str = ""
+    workload: str = ""
 
     @property
     def rolled(self) -> bool:
@@ -278,6 +298,32 @@ class Result:
     found: bool = True
     searched: int = 0
     chunk_id: int = 0
+
+
+@dataclass(frozen=True)
+class WorkResult:
+    """Worker → coordinator (per chunk) and coordinator → client
+    (final) for pluggable workloads (:mod:`tpuminter.workloads`).
+
+    The mining :class:`Result` hard-codes min-fold fields (nonce +
+    hash); a workload answer is whatever its fold discipline says, so
+    ``payload`` carries the discipline's own tagged + CRC-trailed
+    chunk-partial frame, opaque to this layer — the payload CRC is
+    load-bearing on the JSON fallback, where the hex field has no other
+    corruption check. ``wid`` is the registered numeric workload id
+    (cross-checked against the job's workload before verification);
+    ``searched`` counts evaluated indices (first-match early-exit makes
+    it smaller than the range), feeding the same accounting as mining's
+    ``searched``. The found/empty distinction lives INSIDE the payload:
+    each fold encodes its own "nothing here" shape, so this envelope
+    never changes when a new discipline registers.
+    """
+
+    job_id: int
+    chunk_id: int
+    wid: int
+    searched: int
+    payload: bytes = b""
 
 
 @dataclass(frozen=True)
@@ -458,14 +504,15 @@ class SyncAck:
 
 
 Message = Union[
-    Join, Request, Result, Cancel, Setup, Assign, RollAssign, Beacon,
-    Refuse, RepHello, SyncFrom, WalStart, WalBatch, SyncAck,
+    Join, Request, Result, WorkResult, Cancel, Setup, Assign, RollAssign,
+    Beacon, Refuse, RepHello, SyncFrom, WalStart, WalBatch, SyncAck,
 ]
 
 _KINDS = {
     "join": Join,
     "request": Request,
     "result": Result,
+    "wresult": WorkResult,
     "cancel": Cancel,
     "setup": Setup,
     "assign": Assign,
@@ -516,6 +563,14 @@ _TAG_WALBATCH = 0xB8
 #: meaning, and an old peer fails the unknown-tag check loudly.
 _TAG_ASSIGN_ROLL = 0xB9
 _TAG_BEACON = 0xBA
+#: Pluggable-workload chunk/final answer (ISSUE 15): the second
+#: VARIABLE-length binary message — ``tag ‖ job:u64 ‖ chunk:u64 ‖
+#: wid:u8 ‖ searched:u64 ‖ fold payload ‖ crc32``. The payload is a
+#: fold discipline's own tagged+CRC'd frame (tpuminter.workloads.folds,
+#: tags 0xC1-0xC4 in this same process-wide namespace), shipped
+#: opaquely; like WalBatch, the trailing envelope CRC carries the
+#: corruption contract and distinct-length aliasing does not apply.
+_TAG_WRESULT = 0xBB
 
 # Field layouts (little-endian). Every struct is a distinct total size
 # (+4 CRC bytes), so a corrupted tag always fails the length check even
@@ -530,6 +585,8 @@ _BIN_CANCEL = struct.Struct("<BQ")           # tag, job
 _BIN_JOIN = struct.Struct("<BBIQ16s")        # tag, flags, lanes, span,
 #                                              backend (NUL-padded utf8)
 _BIN_WALBATCH_HEAD = struct.Struct("<BQ")    # tag, offset (data follows)
+_BIN_WRESULT_HEAD = struct.Struct("<BQQBQ")  # tag, job, chunk, wid,
+#                                              searched (payload follows)
 _BIN_ASSIGN_ROLL = struct.Struct("<BQQQI")   # tag, job, chunk,
 #                                              extranonce0, count
 _BIN_BEACON = struct.Struct("<BQQQQ32s")     # tag, job, chunk,
@@ -638,7 +695,8 @@ def _encode_binary(msg: Message) -> Optional[bytes]:
         if (len(backend) > 16 or b"\x00" in backend
                 or not 0 <= msg.lanes < (1 << 32)
                 or not 0 <= msg.span < _U64
-                or msg.codec not in ("json", "bin")):
+                or msg.codec not in ("json", "bin")
+                or msg.workloads):  # v1 layout predates the field: JSON
             return None
         flags = _JOIN_FLAG_BIN if msg.codec == "bin" else 0
         if msg.roll:
@@ -652,6 +710,17 @@ def _encode_binary(msg: Message) -> Optional[bytes]:
         return _seal(
             _BIN_WALBATCH_HEAD.pack(_TAG_WALBATCH, msg.offset)
             + bytes(msg.data)
+        )
+    if isinstance(msg, WorkResult):
+        if not (0 <= msg.job_id < _U64 and 0 <= msg.chunk_id < _U64
+                and 0 <= msg.wid < 256 and 0 <= msg.searched < _U64):
+            return None
+        return _seal(
+            _BIN_WRESULT_HEAD.pack(
+                _TAG_WRESULT, msg.job_id, msg.chunk_id, msg.wid,
+                msg.searched,
+            )
+            + bytes(msg.payload)
         )
     return None
 
@@ -671,6 +740,23 @@ def _decode_binary(raw) -> Message:
             raise ProtocolError("binary payload failed its checksum")
         _, offset = _BIN_WALBATCH_HEAD.unpack_from(raw)
         return WalBatch(offset, bytes(view[head : n - _CRC.size]))
+    if tag == _TAG_WRESULT:
+        head = _BIN_WRESULT_HEAD.size
+        if n < head + _CRC.size:
+            raise ProtocolError(f"wresult payload truncated: {n} bytes")
+        view = memoryview(raw)
+        if (
+            zlib.crc32(view[: n - _CRC.size])
+            != _CRC.unpack_from(raw, n - _CRC.size)[0]
+        ):
+            raise ProtocolError("binary payload failed its checksum")
+        _, job_id, chunk_id, wid, searched = (
+            _BIN_WRESULT_HEAD.unpack_from(raw)
+        )
+        return WorkResult(
+            job_id, chunk_id, wid, searched,
+            bytes(view[head : n - _CRC.size]),
+        )
     layout = _BIN_BY_TAG.get(tag)
     if layout is None:
         raise ProtocolError(f"unknown binary message tag {tag:#04x}")
@@ -755,6 +841,8 @@ def _request_obj(msg: Request) -> dict:
         obj["nonce_bits"] = msg.nonce_bits
     if msg.client_key:
         obj["ckey"] = msg.client_key
+    if msg.workload:
+        obj["wl"] = msg.workload
     return obj
 
 
@@ -776,6 +864,7 @@ def _request_from_obj(obj: dict) -> Request:
         branch=tuple(bytes.fromhex(s) for s in obj.get("branch", [])),
         nonce_bits=int(obj.get("nonce_bits", 32)),
         client_key=str(obj.get("ckey", "")),
+        workload=str(obj.get("wl", "")),
     )
 
 
@@ -808,6 +897,8 @@ def encode_msg(msg: Message, *, binary: bool = False) -> bytes:
             obj["codec"] = msg.codec
         if msg.roll:
             obj["roll"] = 1
+        if msg.workloads:
+            obj["wl"] = list(msg.workloads)
     elif isinstance(msg, Request):
         obj = _request_obj(msg)
     elif isinstance(msg, Setup):
@@ -851,6 +942,15 @@ def encode_msg(msg: Message, *, binary: bool = False) -> bytes:
             "found": msg.found,
             "searched": msg.searched,
             "chunk_id": msg.chunk_id,
+        }
+    elif isinstance(msg, WorkResult):
+        obj = {
+            "kind": "wresult",
+            "job_id": msg.job_id,
+            "chunk_id": msg.chunk_id,
+            "wid": msg.wid,
+            "searched": msg.searched,
+            "wp": bytes(msg.payload).hex(),
         }
     elif isinstance(msg, Cancel):
         obj = {"kind": "cancel", "job_id": msg.job_id}
@@ -905,6 +1005,7 @@ def decode_msg(raw) -> Message:
                 span=int(obj.get("span", 0)),
                 codec=str(obj.get("codec", "json")),
                 roll=bool(obj.get("roll", 0)),
+                workloads=tuple(str(w) for w in obj.get("wl", [])),
             )
         if kind == "request":
             return _request_from_obj(obj)
@@ -967,6 +1068,14 @@ def decode_msg(raw) -> Message:
                 found=bool(obj["found"]),
                 searched=int(obj.get("searched", 0)),
                 chunk_id=int(obj.get("chunk_id", 0)),
+            )
+        if kind == "wresult":
+            return WorkResult(
+                job_id=int(obj["job_id"]),
+                chunk_id=int(obj["chunk_id"]),
+                wid=int(obj["wid"]),
+                searched=int(obj.get("searched", 0)),
+                payload=bytes.fromhex(obj.get("wp", "")),
             )
         return Cancel(job_id=int(obj["job_id"]))
     except (KeyError, ValueError, TypeError) as exc:

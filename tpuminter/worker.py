@@ -30,6 +30,7 @@ import time
 from typing import Callable, Iterator, Optional
 
 from tpuminter import chain
+from tpuminter import workloads
 from tpuminter.lsp import LspClient, LspConnectError, LspConnectionLost, Params
 from tpuminter.lsp.params import jittered_backoff
 from tpuminter.lsp.params import FAST
@@ -349,6 +350,11 @@ async def run_miner(
     client.write(encode_msg(Join(
         backend=miner.backend, lanes=miner.lanes, span=miner.span,
         codec="bin" if binary else "json", roll=roll,
+        # advertise every registered workload (ISSUE 15): the
+        # coordinator only dispatches a workload job to workers that
+        # named it here — an old worker advertises nothing and keeps
+        # getting mining chunks, no flag day
+        workloads=workloads.names(),
     )))
     speak_binary = False
 
@@ -422,6 +428,20 @@ async def run_miner(
             if not isinstance(msg, Request):
                 log.warning("worker: unexpected %s, dropping", type(msg).__name__)
                 continue
+            if msg.workload and workloads.maybe(msg.workload) is None:
+                # a coordinator bug (we never advertised this workload)
+                # or a registry drift across versions: Refuse so the
+                # chunk requeues onto a capable worker instead of
+                # wedging this one busy-forever on the books
+                log.warning(
+                    "worker: unregistered workload %r for job %d; "
+                    "refusing chunk %d",
+                    msg.workload, msg.job_id, msg.chunk_id,
+                )
+                client.write(encode_msg(
+                    Refuse(msg.job_id, msg.chunk_id), binary=speak_binary
+                ))
+                continue
 
             # -- mine, keeping one read in flight for Cancel -------------
             # Generator steps run in an executor thread: a step may stall
@@ -443,7 +463,14 @@ async def run_miner(
                 miner.progress_cb = None
             last_beacon = time.monotonic()
             beacon_hw = -1
-            gen = miner.mine(msg)
+            if msg.workload:
+                # the pluggable-workload compute seam (ISSUE 15): the
+                # registered generator runs in the same executor loop,
+                # same yield discipline, same Cancel window — the
+                # engine resolves off this worker's backend
+                gen = workloads.compute(msg, engine=miner.backend)
+            else:
+                gen = miner.mine(msg)
             result: Optional[Result] = None
             cancelled = False
             _done = object()

@@ -1,0 +1,257 @@
+"""Pluggable workload registry (ISSUE 15): the seam that turns the
+mining control plane into a sharded-compute framework.
+
+The Assign/Result plane — journaled, replicated, admission-controlled,
+hedged — is generic infrastructure that happened to mine. This package
+makes the *task type* a registered object instead of an assumption.
+Each :class:`Workload` declares:
+
+- a **params codec** — how a Request's opaque ``data`` bytes describe
+  the job (tagged + CRC-trailed, same framing discipline as the wire
+  codec, proven by the codec-conformance checker);
+- a **fold discipline** (:mod:`tpuminter.workloads.folds`) — how chunk
+  partials reduce to one answer, resolved per-Request from the params;
+- a **verifier** — the off-loop executor check a WorkResult must pass
+  before the coordinator journals its settle (the same seam scrypt
+  verification uses);
+- a **compute seam** — a cooperative generator the cpu/jax workers run
+  per-Setup, yielding ``None`` between batches exactly like the mining
+  generators, so one worker loop serves every workload.
+
+The coordinator stays workload-blind: it resolves a discipline at
+_on_request, then only ever calls the generic fold/coverage helpers
+here. Workload-specific logic lives ONLY under this package — that
+containment is ISSUE 15's acceptance criterion, diff-provable.
+
+**Coverage-gated fold state.** A job's fold state is
+``{"covered": [[lo, hi], ...], "acc": <fold acc>}``. :func:`absorb`
+refuses a chunk whose range overlaps what is already covered, which is
+what makes the NON-idempotent folds (sum) exactly-once under journal
+replay, segmented-WAL merges, WAL re-shipping, and duplicate delivery:
+replaying the same settle twice is a structural no-op, the same
+guarantee interval subtraction gives the mining ledger.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from tpuminter.workloads.folds import (  # noqa: F401  (re-exported)
+    FMin, FirstMatch, Fold, FSum, TopK,
+)
+
+__all__ = [
+    "Workload", "register", "get", "maybe", "by_wid", "names",
+    "new_state", "absorb", "absorb_payload", "merge_states", "fold_of",
+    "compute", "verify_claim",
+    "Fold", "FMin", "TopK", "FirstMatch", "FSum",
+]
+
+
+class Workload:
+    """One registered task type. ``name`` rides Join advertisements,
+    Request/Setup objects, and journal records; ``wid`` is the compact
+    numeric id on binary WorkResult frames (collision-checked at
+    register time and statically by the analysis suite)."""
+
+    name: str = ""
+    wid: int = 0
+
+    def fold_for(self, request) -> Fold:
+        """Resolve the fold discipline this Request's params ask for.
+        Raises ValueError on malformed params (the coordinator turns
+        that into a Refuse)."""
+        raise NotImplementedError
+
+    def compute(self, request, fold: Fold, engine: str = "cpu"):
+        """Cooperative generator: yield ``None`` between batches (the
+        worker loop's executor heartbeat), return ``(searched, acc)``."""
+        raise NotImplementedError
+
+    def verify(self, request, fold: Fold, acc: Any) -> bool:
+        """Off-loop check of a decoded chunk partial against this
+        chunk-Request's exact [lower, upper] range."""
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+_REGISTRY: Dict[str, Workload] = {}
+_BY_WID: Dict[int, Workload] = {}
+
+
+def register(workload: Workload) -> Workload:
+    """Register a workload; collisions on name or wid are programming
+    errors and fail loudly at import time."""
+    if not workload.name:
+        raise ValueError("workload needs a non-empty name")
+    if not 1 <= workload.wid < 256:
+        raise ValueError("workload wid must be a u8 in [1, 255]")
+    have = _REGISTRY.get(workload.name)
+    if have is not None and have is not workload:
+        raise ValueError(f"workload name {workload.name!r} already taken")
+    have = _BY_WID.get(workload.wid)
+    if have is not None and have is not workload:
+        raise ValueError(
+            f"workload wid {workload.wid} already taken by {have.name!r}"
+        )
+    _REGISTRY[workload.name] = workload
+    _BY_WID[workload.wid] = workload
+    return workload
+
+
+def get(name: str) -> Workload:
+    return _REGISTRY[name]
+
+
+def maybe(name: str) -> Optional[Workload]:
+    return _REGISTRY.get(name)
+
+
+def by_wid(wid: int) -> Optional[Workload]:
+    return _BY_WID.get(wid)
+
+
+def names() -> Tuple[str, ...]:
+    """Sorted registered names — what a worker's Join advertises."""
+    return tuple(sorted(_REGISTRY))
+
+
+# ---------------------------------------------------------------------------
+# coverage-gated fold state (the per-fold exactly-once mechanism)
+# ---------------------------------------------------------------------------
+
+def new_state(fold: Fold) -> dict:
+    return {"covered": [], "acc": fold.initial()}
+
+
+def _overlaps(covered: List[list], lo: int, hi: int) -> bool:
+    return any(not (hi < a or b < lo) for a, b in covered)
+
+
+def _cover(covered: List[list], lo: int, hi: int) -> List[list]:
+    """Insert inclusive [lo, hi] and coalesce touching spans."""
+    spans = sorted([list(s) for s in covered] + [[lo, hi]])
+    out: List[list] = []
+    for a, b in spans:
+        if out and a <= out[-1][1] + 1:
+            out[-1][1] = max(out[-1][1], b)
+        else:
+            out.append([a, b])
+    return out
+
+
+def _span(covered: List[list]) -> int:
+    return sum(b - a + 1 for a, b in covered)
+
+
+def absorb(fold: Fold, state: dict, lo: int, hi: int, acc: Any) -> bool:
+    """Fold one chunk partial into ``state`` unless its range is
+    already covered. Returns False (state untouched) on a duplicate —
+    the gate that makes every discipline replay-idempotent."""
+    if lo > hi or _overlaps(state["covered"], lo, hi):
+        return False
+    state["covered"] = _cover(state["covered"], lo, hi)
+    state["acc"] = fold.combine(state["acc"], acc)
+    return True
+
+
+def merge_states(
+    fold: Fold, a: Optional[dict], b: Optional[dict]
+) -> Optional[dict]:
+    """Merge two fold states from independent WAL segments
+    (journal.merge_states' per-job rule, generalized). Idempotent folds
+    combine unconditionally; for sum, overlapping coverage would
+    double-count, so overlap degrades to keeping the larger-coverage
+    state — the same conservative bias the mining merge takes (re-mine
+    rather than corrupt)."""
+    if a is None or not a["covered"]:
+        return b if a is None else (b or a)
+    if b is None or not b["covered"]:
+        return a
+    disjoint = all(
+        not _overlaps(a["covered"], lo, hi) for lo, hi in b["covered"]
+    )
+    if fold.idempotent or disjoint:
+        covered = a["covered"]
+        for lo, hi in b["covered"]:
+            covered = _cover(covered, lo, hi)
+        return {"covered": covered, "acc": fold.combine(a["acc"], b["acc"])}
+    return a if _span(a["covered"]) >= _span(b["covered"]) else b
+
+
+# ---------------------------------------------------------------------------
+# the three call sites outside this package: worker, coordinator, journal
+# ---------------------------------------------------------------------------
+
+def fold_of(request) -> Optional[Fold]:
+    """Resolve the discipline a Request's workload + params name, or
+    None when the workload is unknown or the params are malformed."""
+    workload = _REGISTRY.get(getattr(request, "workload", "") or "")
+    if workload is None:
+        return None
+    try:
+        return workload.fold_for(request)
+    except ValueError:
+        return None
+
+
+def compute(request, engine: str = "cpu") -> Iterator:
+    """The worker-side seam: run the registered compute generator for
+    one chunk-Request and yield its final WorkResult — a drop-in for
+    ``miner.mine(request)`` in the worker's executor loop."""
+    from tpuminter.protocol import WorkResult
+
+    workload = get(request.workload)
+    fold = workload.fold_for(request)
+    searched, acc = yield from workload.compute(request, fold, engine)
+    yield WorkResult(
+        job_id=request.job_id,
+        chunk_id=request.chunk_id,
+        wid=workload.wid,
+        searched=searched,
+        payload=fold.encode(acc),
+    )
+
+
+def verify_claim(request, msg) -> bool:
+    """The coordinator-side off-loop verifier: does this WorkResult's
+    payload hold up against the chunk-Request it answers? Runs in the
+    verification executor (same seam as scrypt), so recompute-grade
+    verifiers (sum, first-match absence proofs) never stall the loop."""
+    workload = _REGISTRY.get(getattr(request, "workload", "") or "")
+    if workload is None or getattr(msg, "wid", None) != workload.wid:
+        return False
+    try:
+        fold = workload.fold_for(request)
+        acc = fold.decode(msg.payload)
+    except ValueError:
+        return False
+    return workload.verify(request, fold, acc)
+
+
+def absorb_payload(
+    request, state: Optional[dict], lo: int, hi: int, payload: bytes
+) -> Tuple[Optional[dict], bool]:
+    """The journal-side seam: absorb one settle record's ``"wp"`` bytes
+    into a (possibly fresh) fold state, coverage-gated. Returns
+    ``(state, absorbed)``; a duplicate or undecodable payload leaves
+    the state untouched — replay never corrupts, it only skips."""
+    workload = _REGISTRY.get(getattr(request, "workload", "") or "")
+    if workload is None:
+        return state, False
+    try:
+        fold = workload.fold_for(request)
+        acc = fold.decode(payload)
+    except ValueError:
+        return state, False
+    if state is None:
+        state = new_state(fold)
+    return state, absorb(fold, state, lo, hi, acc)
+
+
+# built-in workloads self-register on import (bottom import: the
+# registry API above must exist before hashcore's module body runs)
+from tpuminter.workloads import hashcore  # noqa: E402,F401

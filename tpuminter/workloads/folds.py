@@ -1,0 +1,354 @@
+"""Fold disciplines: how chunk-level partial results combine into one
+job-level answer, and how each partial is framed on the wire/journal.
+
+The mining plane folds by *min* — every settle carries a candidate
+``(hash, nonce)`` and the job keeps the smallest (coordinator
+``_Job.fold``). ISSUE 15 generalizes that one hard-coded reduction into
+a discipline object with four registered shapes:
+
+- **fmin** — the mining default, generalized: keep the single best
+  ``(value, index)`` pair, ties at the lowest index.
+- **top-k** — keep the k best pairs, globally ordered by
+  ``(value, index)`` so ties always resolve to the lowest index.
+- **first-match** — the earliest index whose value clears a threshold;
+  ``is_final`` fires the coordinator's early-finish path (the Cancel
+  broadcast that already retires a found mining job).
+- **sum** — map-reduce: total + count. The only NON-idempotent fold;
+  replay safety comes from the coverage gate in
+  :mod:`tpuminter.workloads` (a settle absorbed twice is a no-op), not
+  from the algebra.
+
+Each discipline owns its chunk-partial codec: a tagged, CRC-trailed
+binary frame in the same ``tag ‖ fields ‖ crc32`` shape as the PR 4
+wire codec, carried opaquely inside WorkResult payloads and journal
+settle records (``"wp"`` field). Tags 0xC1–0xC4 live in the same
+process-wide byte namespace as the wire/journal tags (0xB1–0xBB) — the
+codec-conformance checker proves the non-collision statically. The
+payload-level CRC is load-bearing: a JSON-fallback WorkResult carries
+the payload as bare hex with no envelope CRC, so the trailer here is
+the only corruption check those bytes ever get.
+
+Accumulators are plain JSON-able values (lists/ints/None) so they ride
+journal snapshots and replication unchanged.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Any, List, Optional
+
+__all__ = [
+    "Fold", "FMin", "TopK", "FirstMatch", "FSum", "seal_payload",
+]
+
+_U64 = 1 << 64
+_U128 = 1 << 128
+
+#: Chunk-partial codec tags. Same rules as protocol.py v1: never reuse,
+#: never collide with '{' (0x7B), new layouts get NEW tags.
+_TAG_WMIN = 0xC1
+_TAG_WTOPK = 0xC2
+_TAG_WMATCH = 0xC3
+_TAG_WSUM = 0xC4
+
+#: Top-k payloads carry a fixed 8-slot table (k <= 8 is enforced at
+#: params parse); unused slots are zero and ignored past ``count``.
+TOPK_SLOTS = 8
+
+# Distinct total packed lengths (the checker's secondary dispatch key):
+# 18, 130, 26, 25 (+4 CRC each).
+_BIN_WMIN = struct.Struct("<BBQQ")        # tag, has, value, index
+# tag, count, then TOPK_SLOTS (value, index) pairs. The format is a
+# literal (not "QQ" * TOPK_SLOTS) so the codec-conformance checker's
+# AST extractor sees the layout and keeps this kind under its eye.
+_BIN_WTOPK = struct.Struct("<BBQQQQQQQQQQQQQQQQ")
+_BIN_WMATCH = struct.Struct("<BBQQQ")     # tag, has, index, value, probes
+_BIN_WSUM = struct.Struct("<B16sQ")       # tag, total (u128 LE), count
+_CRC = struct.Struct("<I")
+
+assert _BIN_WTOPK.size == 2 + 16 * TOPK_SLOTS, "slot table out of sync"
+
+
+def seal_payload(body: bytes) -> bytes:
+    """``body ‖ crc32(body)`` — the chunk-partial frame trailer."""
+    return body + _CRC.pack(zlib.crc32(body))
+
+
+def _open_payload(data: bytes, layout: struct.Struct, tag: int) -> tuple:
+    """Validate length, tag, and CRC; unpack. Raises ValueError on any
+    mismatch — callers treat a bad payload like a bad wire frame."""
+    if len(data) != layout.size + _CRC.size:
+        raise ValueError(
+            f"fold payload: want {layout.size + _CRC.size} bytes, "
+            f"got {len(data)}"
+        )
+    body, (crc,) = data[:-_CRC.size], _CRC.unpack(data[-_CRC.size:])
+    if zlib.crc32(body) != crc:
+        raise ValueError("fold payload: CRC mismatch")
+    fields = layout.unpack(body)
+    if fields[0] != tag:
+        raise ValueError(
+            f"fold payload: tag 0x{fields[0]:02X}, want 0x{tag:02X}"
+        )
+    return fields
+
+
+class Fold:
+    """One reduction discipline. Accumulators are JSON-able; ``combine``
+    is associative and commutative so segmented-WAL merges and replay
+    order don't matter. ``idempotent`` declares whether combining
+    overlapping coverage is harmless (min/top-k/first-match) or corrupts
+    the answer (sum) — the coverage gate consults it."""
+
+    name = "fold"
+    idempotent = True
+
+    def initial(self) -> Any:
+        return None
+
+    def combine(self, a: Any, b: Any) -> Any:
+        raise NotImplementedError
+
+    def of_batch(self, index0: int, values: List[int]) -> Any:
+        """Fold one contiguous batch of objective values starting at
+        global ``index0`` into a chunk-partial accumulator."""
+        raise NotImplementedError
+
+    def is_final(self, acc: Any) -> bool:
+        """True when this accumulator already decides the job — the
+        coordinator finishes early and Cancel-broadcasts the rest."""
+        return False
+
+    def found(self, acc: Any) -> bool:
+        """The finish-record ``found`` flag once the range exhausts."""
+        return acc is not None
+
+    def encode(self, acc: Any) -> bytes:
+        raise NotImplementedError
+
+    def decode(self, data: bytes) -> Any:
+        raise NotImplementedError
+
+    def describe(self, acc: Any) -> str:
+        """Human rendering for the client CLI."""
+        return repr(acc)
+
+
+class FMin(Fold):
+    """Keep the single smallest ``[value, index]``; ties break to the
+    lowest index (total order ``(value, index)``, matching the mining
+    plane's deterministic winner)."""
+
+    name = "fmin"
+
+    def combine(self, a, b):
+        if a is None:
+            return None if b is None else list(b)
+        if b is None:
+            return list(a)
+        return list(min((tuple(a), tuple(b))))
+
+    def of_batch(self, index0, values):
+        if not values:
+            return None
+        value = min(values)
+        return [value, index0 + values.index(value)]
+
+    def encode(self, acc):
+        if acc is None:
+            return seal_payload(_BIN_WMIN.pack(_TAG_WMIN, 0, 0, 0))
+        value, index = acc
+        if not (0 <= value < _U64 and 0 <= index < _U64):
+            raise ValueError("fmin acc out of u64 range")
+        return seal_payload(_BIN_WMIN.pack(_TAG_WMIN, 1, value, index))
+
+    def decode(self, data):
+        _tag, has, value, index = _open_payload(data, _BIN_WMIN, _TAG_WMIN)
+        return [value, index] if has else None
+
+    def describe(self, acc):
+        if acc is None:
+            return "fmin: empty range"
+        return f"fmin: value={acc[0]} index={acc[1]}"
+
+
+class TopK(Fold):
+    """Keep the ``k`` smallest ``[value, index]`` pairs, globally sorted
+    by ``(value, index)`` — equal values always rank the LOWER global
+    index first, so the answer is one deterministic list no matter how
+    chunks interleave."""
+
+    name = "topk"
+
+    def __init__(self, k: int):
+        if not 1 <= k <= TOPK_SLOTS:
+            raise ValueError(f"topk: k must be in [1, {TOPK_SLOTS}]")
+        self.k = k
+
+    def initial(self):
+        return []
+
+    def combine(self, a, b):
+        merged = {int(i): int(v) for v, i in (a or [])}
+        # same index seen twice can only carry the same deterministic
+        # value; keep the smaller defensively
+        for v, i in (b or []):
+            v, i = int(v), int(i)
+            merged[i] = min(merged.get(i, v), v)
+        pairs = sorted([v, i] for i, v in merged.items())
+        return pairs[: self.k]
+
+    def of_batch(self, index0, values):
+        pairs = sorted(
+            [value, index0 + off] for off, value in enumerate(values)
+        )
+        return pairs[: self.k]
+
+    def found(self, acc):
+        return bool(acc)
+
+    def encode(self, acc):
+        acc = acc or []
+        if len(acc) > TOPK_SLOTS:
+            raise ValueError("topk acc exceeds the slot table")
+        flat = []
+        for value, index in acc:
+            if not (0 <= value < _U64 and 0 <= index < _U64):
+                raise ValueError("topk acc out of u64 range")
+            flat.extend((value, index))
+        flat.extend([0] * (2 * TOPK_SLOTS - len(flat)))
+        return seal_payload(_BIN_WTOPK.pack(_TAG_WTOPK, len(acc), *flat))
+
+    def decode(self, data):
+        fields = _open_payload(data, _BIN_WTOPK, _TAG_WTOPK)
+        count = fields[1]
+        if count > TOPK_SLOTS:
+            raise ValueError("topk payload: count exceeds the slot table")
+        return [
+            [fields[2 + 2 * s], fields[3 + 2 * s]] for s in range(count)
+        ]
+
+    def describe(self, acc):
+        if not acc:
+            return "topk: empty range"
+        rows = "\n".join(
+            f"  #{rank + 1} value={v} index={i}"
+            for rank, (v, i) in enumerate(acc)
+        )
+        return f"topk ({len(acc)}):\n{rows}"
+
+
+class FirstMatch(Fold):
+    """The earliest global index whose value is <= ``threshold``.
+    ``is_final`` lets the coordinator finish the job on the first
+    matching chunk and Cancel-broadcast the outstanding ones — the same
+    early-retire path a found mining job takes.
+
+    The accumulator is ``[index, value, probes]`` where a DRY scan is
+    ``[None, None, probes]`` — the no-match partial still carries how
+    many indices it evaluated, so combining a dry prefix batch with a
+    matching one yields chunk-relative probes by construction
+    (``probes == index - lo + 1`` is then a verifiable claim, and a dry
+    chunk's ``probes == hi - lo + 1`` proves it scanned everything)."""
+
+    name = "fmatch"
+
+    def __init__(self, threshold: int):
+        if not 0 <= threshold < _U64:
+            raise ValueError("fmatch: threshold out of u64 range")
+        self.threshold = threshold
+
+    def combine(self, a, b):
+        if a is None:
+            return None if b is None else list(b)
+        if b is None:
+            return list(a)
+        probes = a[2] + b[2]
+        if a[0] is None:
+            keep = b
+        elif b[0] is None:
+            keep = a
+        else:
+            keep = a if a[0] <= b[0] else b
+        return [keep[0], keep[1], probes]
+
+    def of_batch(self, index0, values):
+        for off, value in enumerate(values):
+            if value <= self.threshold:
+                return [index0 + off, value, off + 1]
+        return [None, None, len(values)] if values else None
+
+    def is_final(self, acc):
+        return acc is not None and acc[0] is not None
+
+    def found(self, acc):
+        return acc is not None and acc[0] is not None
+
+    def encode(self, acc):
+        if acc is None:
+            acc = [None, None, 0]
+        index, value, probes = acc
+        if not 0 <= probes < _U64:
+            raise ValueError("fmatch probes out of u64 range")
+        if index is None:
+            return seal_payload(
+                _BIN_WMATCH.pack(_TAG_WMATCH, 0, 0, 0, probes)
+            )
+        if not (0 <= index < _U64 and 0 <= value < _U64):
+            raise ValueError("fmatch acc out of u64 range")
+        return seal_payload(
+            _BIN_WMATCH.pack(_TAG_WMATCH, 1, index, value, probes)
+        )
+
+    def decode(self, data):
+        _tag, has, index, value, probes = _open_payload(
+            data, _BIN_WMATCH, _TAG_WMATCH
+        )
+        if has:
+            return [index, value, probes]
+        return [None, None, probes] if probes else None
+
+    def describe(self, acc):
+        if acc is None or acc[0] is None:
+            return "fmatch: no match"
+        return f"fmatch: index={acc[0]} value={acc[1]} probes={acc[2]}"
+
+
+class FSum(Fold):
+    """Map-reduce: ``[total, count]``. NOT idempotent — absorbing the
+    same chunk twice double-counts — so exactly-once rests entirely on
+    the coverage gate; the journal's interval subtraction and the gate
+    see the same ranges, which the property tests pin."""
+
+    name = "fsum"
+    idempotent = False
+
+    def initial(self):
+        return [0, 0]
+
+    def combine(self, a, b):
+        a, b = a or [0, 0], b or [0, 0]
+        return [a[0] + b[0], a[1] + b[1]]
+
+    def of_batch(self, index0, values):
+        return [sum(values), len(values)]
+
+    def found(self, acc):
+        return True
+
+    def encode(self, acc):
+        total, count = acc or [0, 0]
+        if not (0 <= count < _U64 and 0 <= total < _U128):
+            raise ValueError("fsum acc out of range (u128 total, u64 count)")
+        return seal_payload(_BIN_WSUM.pack(
+            _TAG_WSUM, total.to_bytes(16, "little"), count
+        ))
+
+    def decode(self, data):
+        _tag, total, count = _open_payload(data, _BIN_WSUM, _TAG_WSUM)
+        return [int.from_bytes(total, "little"), count]
+
+    def describe(self, acc):
+        acc = acc or [0, 0]
+        return f"fsum: total={acc[0]} count={acc[1]}"
