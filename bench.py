@@ -986,6 +986,16 @@ def bench_rolled(pairs: int = 5, nb_points=(8, 12), width: int = 256,
     real. ``width`` 256 is the measured CPU cache knee (PERF.md §Round
     12); both sides dispatch at the same width so the A/B isolates
     orchestration, not shape.
+
+    ISSUE 16 adds the schedule-sharing A/B on top: ``rolled_sched_*``
+    pairs the SAME batched fast job with ``sched_share`` on vs off —
+    isolating the shared-schedule truncated hash + roll dedup from the
+    batching win — with dispatch counters on both sides (the layer must
+    not change dispatches/segment) and the ``autotune_width`` probe
+    winner recorded. ``rolled_fast_*`` runs the production defaults, so
+    from round 14 on its batched side includes the sched layer (the
+    trajectory step vs rounds 7-13 IS the ISSUE 16 win); the segmented
+    side is the untouched pre-batching baseline as always.
     """
     import numpy as np
 
@@ -1023,10 +1033,10 @@ def bench_rolled(pairs: int = 5, nb_points=(8, 12), width: int = 256,
             extranonce_size=4, branch=branch, nonce_bits=nb,
         )
 
-        def fast(rb, counters=None):
+        def fast(rb, counters=None, sched=True):
             return drain_rate(_rolled.mine_rolled_fast(
                 fast_req, slab=width, roll_batch=rb, engine="jnp",
-                counters=counters,
+                sched_share=sched, counters=counters,
             ))
 
         def track(rb):
@@ -1035,8 +1045,10 @@ def bench_rolled(pairs: int = 5, nb_points=(8, 12), width: int = 256,
             )
 
         fast(roll_batch), fast(1), track(roll_batch), track(1)  # warm
+        fast(roll_batch, sched=False)  # warm the sched-off A/B program
         f_ratios, t_ratios, f_b, f_s = [], [], [], []
-        disp = {}
+        s_ratios, s_on, s_off = [], [], []
+        disp, sdisp = {}, {}
         for _ in range(pairs):
             c_s, c_b = {}, {}
             s = fast(1, c_s)
@@ -1044,11 +1056,35 @@ def bench_rolled(pairs: int = 5, nb_points=(8, 12), width: int = 256,
             f_s.append(s)
             f_b.append(b)
             f_ratios.append(b / s)
+            c_off, c_on = {}, {}
+            r_off = fast(roll_batch, c_off, sched=False)
+            r_on = fast(roll_batch, c_on)
+            s_off.append(r_off)
+            s_on.append(r_on)
+            s_ratios.append(r_on / r_off)
             t_s, t_b = track(1), track(roll_batch)
             t_ratios.append(t_b / t_s)
             disp = {"batched": c_b, "segmented": c_s}
+            sdisp = {"on": c_on, "off": c_off}
         lo, hi = _iqr_band(f_ratios)
+        s_lo, s_hi = _iqr_band(s_ratios)
         seg_scale = (1 << nb) / span  # dispatches per 2^nonce_bits indices
+        out.update({
+            f"rolled_sched_mhs_on_nb{nb}": round(max(s_on) / 1e6, 4),
+            f"rolled_sched_mhs_off_nb{nb}": round(max(s_off) / 1e6, 4),
+            f"rolled_sched_speedup_pct_median_nb{nb}": round(
+                100.0 * (statistics.median(s_ratios) - 1.0), 1
+            ),
+            f"rolled_sched_speedup_pct_iqr_nb{nb}": [
+                round(100.0 * (s_lo - 1.0), 1), round(100.0 * (s_hi - 1.0), 1)
+            ],
+            f"rolled_sched_dispatches_per_segment_on_nb{nb}": round(
+                sum(sdisp["on"].values()) * seg_scale, 3
+            ),
+            f"rolled_sched_dispatches_per_segment_off_nb{nb}": round(
+                sum(sdisp["off"].values()) * seg_scale, 3
+            ),
+        })
         out.update({
             f"rolled_fast_mhs_batched_nb{nb}": round(max(f_b) / 1e6, 4),
             f"rolled_fast_mhs_segmented_nb{nb}": round(max(f_s) / 1e6, 4),
@@ -1070,6 +1106,7 @@ def bench_rolled(pairs: int = 5, nb_points=(8, 12), width: int = 256,
         })
     out["rolled_roll_batch"] = roll_batch
     out["rolled_width"] = width
+    out["rolled_autotune_width"] = _rolled.autotune_width()
     return out
 
 

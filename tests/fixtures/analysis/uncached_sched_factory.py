@@ -1,0 +1,39 @@
+"""True-positive fixture: an uncached shared-schedule jit factory.
+
+The ISSUE 16 hazard variant of the pre-PR-7 bug class: a sweep helper
+that builds a fresh ``jax.jit`` wrapper around the shared-schedule
+(prepare-once, finish-per-nonce) hash on every dispatch. The schedule
+prefix IS hoisted — but the wrapper itself is rebuilt per call, so each
+job re-traces the whole unrolled second compression (~3 s measured per
+(width, cand_bits) on CPU), and the amortization the layer exists for
+never happens. Also carries the sibling hazard: the prepared-schedule
+tuple passed as a list into an ``lru_cache``'d factory, silently
+defeating the cache at runtime. Parsed by tests/test_analysis.py, never
+imported.
+"""
+
+from functools import lru_cache
+
+import jax
+
+
+def sched_sweep(prep, nonces, width):
+    # rebuilt per dispatch: the unrolled 64-round graph re-traces on
+    # every window even though the schedule prefix was shared
+    finish = jax.jit(lambda p, n: _finish_prepared(p, n), static_argnums=())
+    return finish(prep, nonces)
+
+
+@lru_cache(maxsize=32)
+def build_sched_sweep(width, cand_bits):
+    return jax.jit(lambda p, n: _finish_prepared(p, n))
+
+
+def dispatch_window(prep, nonces):
+    # unhashable argument defeats the factory cache at runtime: every
+    # window builds (and traces) a brand-new sweep program
+    return build_sched_sweep(256, [8, 32])(prep, nonces)
+
+
+def _finish_prepared(prep, nonces):
+    return prep[0] + nonces

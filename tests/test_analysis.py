@@ -129,6 +129,27 @@ def test_retrace_catches_pre_pr7_uncached_jit():
     )
 
 
+def test_retrace_catches_uncached_sched_factory():
+    """ISSUE 16 hazard variant: a shared-schedule sweep whose jit
+    wrapper is rebuilt per dispatch re-traces the whole unrolled
+    compression every window — `tpuminter.analysis` must flag it (the
+    production factories are lru_cached precisely for this)."""
+    findings = _fixture_findings(
+        "uncached_sched_factory.py", ["retrace-hazard"]
+    )
+    assert any(
+        f.qualname == "sched_sweep" and f.symbol == "jax.jit"
+        for f in findings
+    )
+    # the cached factory is the FIX — it must stay quiet...
+    assert not any(f.qualname == "build_sched_sweep" for f in findings)
+    # ...but the list literal defeating it at the call site must be loud
+    assert any(
+        f.qualname == "dispatch_window" and "unhashable" in f.message
+        for f in findings
+    )
+
+
 def test_thread_seam_catches_cross_loop_write():
     findings = _fixture_findings("cross_loop_write.py", ["thread-seam"])
     assert any(

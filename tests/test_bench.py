@@ -39,6 +39,20 @@ def test_bench_smoke_emits_one_json_line():
         obj["extra"]["rolled_dispatches_per_segment_batched_nb8"]
         < obj["extra"]["rolled_dispatches_per_segment_segmented_nb8"]
     )
+    # the schedule-sharing A/B rides every capture (ISSUE 16): both
+    # sides of the sched on/off pair measured on the SAME batched job,
+    # the layer cannot change how many dispatches cover a segment, and
+    # the autotune probe picked a real candidate width
+    assert obj["extra"]["rolled_sched_mhs_on_nb8"] > 0
+    assert obj["extra"]["rolled_sched_mhs_off_nb8"] > 0
+    assert isinstance(
+        obj["extra"]["rolled_sched_speedup_pct_median_nb8"], (int, float)
+    )
+    assert (
+        obj["extra"]["rolled_sched_dispatches_per_segment_on_nb8"]
+        == obj["extra"]["rolled_sched_dispatches_per_segment_off_nb8"]
+    )
+    assert obj["extra"]["rolled_autotune_width"] in (128, 256, 512, 1024)
     # the roll-budget control-plane A/B rides every capture too
     # (ISSUE 14): both arms measured at both nonce_bits points, every
     # rolled_check gate held, and the production-shape collapse at or

@@ -226,6 +226,48 @@ assert (rb.found, rb.nonce, rb.hash_value) == (r1.found, r1.nonce, r1.hash_value
 assert rb.nonce == (1 << 32) + 2804947108
 print("SECTION-OK")
 """,
+    # --- shared-compression scheduling (ISSUE 16): the sched=True kernel
+    # body (per-row schedule prefix hoisted via sym.prepare_hdr) returns
+    # bit-identical (found, first_off) rows to the sched=False baseline
+    # on the real chip — winner rows, ragged valids, and padding rows —
+    # and TpuMiner's production default (sched_share on) still lands the
+    # exact cross-extranonce winner of the rolled_batched fixture
+    "sched_share": r"""
+from tpuminter.kernels import pallas_search_candidates_hdr_batch
+from tpuminter.ops import merkle
+from tpuminter.tpu_worker import TpuMiner
+rng3 = np.random.RandomState(0)
+cb_prefix = rng3.bytes(41); cb_suffix = rng3.bytes(60)
+cb_branch = tuple(rng3.bytes(32) for _ in range(2))
+roll_b = merkle.make_extranonce_roll_batch(
+    GEN.pack(), cb_prefix, cb_suffix, 4, cb_branch)
+mids, tails = roll_b(jnp.zeros(3, jnp.uint32),
+                     jnp.asarray(np.array([0, 1, 2], np.uint32)))
+W = 1 << 14
+bases = np.array([100, 2804947108 - 5000, 100], np.uint32)  # row 1 wins
+valids = np.array([W, W, 0], np.uint32)  # row 2: pure padding
+args = (mids, tails, jnp.asarray(bases), jnp.asarray(valids), W, 8, cap1)
+f0, o0 = (np.asarray(x) for x in
+          pallas_search_candidates_hdr_batch(*args, sched=False))
+f1, o1 = (np.asarray(x) for x in
+          pallas_search_candidates_hdr_batch(*args, sched=True))
+assert np.array_equal(f0, f1) and int(f1[1]) == 1
+assert int(o0[1]) == int(o1[1]) == 2804947108 - int(bases[1])
+
+# end-to-end: production default (sched_share on) == off, and both land
+# the known cross-extranonce winner through the whole candidate plane
+TGT = 0x6d278107d5385a15ebb7b627ad622562f7bc65132eba75b00c300cde
+req8 = Request(job_id=8, mode=PowMode.TARGET, lower=0, upper=(2 << 32) - 1,
+               header=GEN.pack(), target=TGT,
+               coinbase_prefix=cb_prefix, coinbase_suffix=cb_suffix,
+               extranonce_size=4, branch=cb_branch, nonce_bits=32)
+r_on = drain(TpuMiner(roll_batch=4).mine(req8))
+r_off = drain(TpuMiner(roll_batch=4, sched_share=False).mine(req8))
+assert (r_on.found, r_on.nonce, r_on.hash_value) == (
+    r_off.found, r_off.nonce, r_off.hash_value)
+assert r_on.nonce == (1 << 32) + 2804947108
+print("SECTION-OK")
+""",
     # --- pod paths on the real chip (1-chip mesh): the shard_map'd Pallas
     # MIN sweep (full span + ragged tail) and the exact-min TARGET sweep
     # (build_exact_sweep_pallas: pallas_search_target per chip, pipelined

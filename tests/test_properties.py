@@ -1068,3 +1068,83 @@ def test_topk_ties_always_rank_the_lowest_global_index(seed, lo, span, k):
         fold.of_batch(mid + 1, values[mid - lo + 1:]),
     )
     assert acc == want
+
+
+# ---------------------------------------------------------------------------
+# shared-compression scheduling (ISSUE 16; seeded mirrors in
+# tests/test_sched_share.py since this image lacks hypothesis)
+# ---------------------------------------------------------------------------
+
+@settings(max_examples=15, deadline=None)
+@given(
+    seed=st.integers(0, 2**32 - 1),
+    b=st.integers(1, 6),
+    width=st.sampled_from([32, 64]),
+    cand_bits=st.sampled_from([8, 32]),
+)
+def test_batched_sweep_sched_share_bit_equal(seed, b, width, cand_bits):
+    """The shared-schedule sweep (``sched=True``) returns the identical
+    ``[found, first_goff]`` pair as the full-digest baseline on any row
+    set — random midstates/tails/bases, ragged valid counts included."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from tpuminter import rolled
+
+    rng = np.random.RandomState(seed)
+    mids = jnp.asarray(rng.randint(0, 1 << 32, (b, 8), dtype=np.uint32))
+    tails = jnp.asarray(rng.randint(0, 1 << 32, (b, 3), dtype=np.uint32))
+    bases = jnp.asarray(rng.randint(0, 1 << 20, b, dtype=np.uint32))
+    valids = jnp.asarray(rng.randint(0, width + 1, b).astype(np.uint32))
+    goffs = jnp.asarray((np.arange(b, dtype=np.uint64) * width)
+                        .astype(np.uint32))
+    cap = jnp.uint32(rng.randint(0, 1 << 32))
+    args = (mids, tails, bases, valids, goffs, cap, width, cand_bits)
+    assert np.array_equal(
+        np.asarray(rolled._jnp_batched_candidate_sweep(*args, False)),
+        np.asarray(rolled._jnp_batched_candidate_sweep(*args, True)),
+    )
+
+
+@settings(max_examples=20, deadline=None)
+@given(ens=st.lists(st.integers(0, 2**64 - 1), min_size=1, max_size=12))
+def test_roll_batch_deduped_any_row_multiset(ens):
+    """Dedup-then-gather ≡ rolling every row, for ANY multiset of
+    64-bit extranonces (duplicates, all-equal, all-distinct)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from tpuminter.ops import merkle
+
+    rng = np.random.RandomState(16)
+    prefix, suffix = rng.bytes(41), rng.bytes(60)
+    roll = merkle.make_extranonce_roll_batch(
+        chain.GENESIS_HEADER.pack(), prefix, suffix, 8, ()
+    )
+    en = np.asarray(ens, dtype=np.uint64)
+    en_hi = (en >> np.uint64(32)).astype(np.uint32)
+    en_lo = (en & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    want_m, want_t = roll(jnp.asarray(en_hi), jnp.asarray(en_lo))
+    got_m, got_t = merkle.roll_batch_deduped(roll, en_hi, en_lo)
+    assert np.array_equal(np.asarray(want_m), np.asarray(got_m))
+    assert np.array_equal(np.asarray(want_t), np.asarray(got_t))
+
+
+@settings(max_examples=40)
+@given(
+    mid=st.lists(st.integers(0, 2**32 - 1), min_size=8, max_size=8),
+    tail=st.lists(st.integers(0, 2**32 - 1), min_size=3, max_size=3),
+    nonce=st.integers(0, 2**32 - 1),
+)
+def test_prepared_schedule_folds_like_unshared(mid, tail, nonce):
+    """prepare_hdr + hash_prepared_e60_e61 ≡ hash_sym_e60_e61 over the
+    all-int domain — both fully const-fold, and agree on every bit."""
+    from tpuminter.ops import sha256 as ops
+    from tpuminter.ops import symbolic as sym
+
+    bswap = lambda x: int.from_bytes(x.to_bytes(4, "little"), "big")
+    block = [*tail, bswap(nonce), *ops.HEADER_TAIL_PAD]
+    want = sym.hash_sym_e60_e61(mid, [block], (), 0, 0)
+    got = sym.hash_prepared_e60_e61(sym.prepare_hdr(mid, *tail), nonce)
+    assert isinstance(got[0], int) and isinstance(got[1], int)
+    assert got == want

@@ -23,6 +23,7 @@ def _bare_tpu_miner(slab=1 << 12, roll_batch=8):
     miner.depth = 2
     miner.exact_min = False
     miner.roll_batch = roll_batch
+    miner.sched_share = True
     miner._scrypt_delegate = None
     miner.lanes = 1
     return miner

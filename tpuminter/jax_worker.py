@@ -108,6 +108,7 @@ class JaxMiner(Miner):
         scrypt_batch: int = 256,
         depth: int = 2,
         roll_batch: int = 8,
+        sched_share: bool = True,
     ):
         self.batch = batch
         #: extranonce rows per rolled dispatch (tpuminter.rolled): one
@@ -115,6 +116,11 @@ class JaxMiner(Miner):
         #: worth of indices, pipelined across segment boundaries.
         #: 1 = the per-segment A/B baseline (`--roll-batch 1`).
         self.roll_batch = roll_batch
+        #: ISSUE 16 schedule-sharing layer on the rolled path (for the
+        #: tracking miner this is the roll-side extranonce dedup; the
+        #: sweep-side truncated hash lives in mine_rolled_fast). False
+        #: restores the exact pre-ISSUE-16 dispatches for A/B.
+        self.sched_share = sched_share
         # scrypt's ROMix scratch is 128 KiB per in-flight nonce, so the
         # memory-hard dialect gets its own (much smaller) batch size:
         # scrypt_batch × 128 KiB of V lives on device per step
@@ -310,7 +316,8 @@ class JaxMiner(Miner):
 
             yield from rolled.mine_rolled_tracking(
                 req, width_cap=self.batch, depth=self.depth,
-                roll_batch=self.roll_batch, progress=self.progress_cb,
+                roll_batch=self.roll_batch, sched_share=self.sched_share,
+                progress=self.progress_cb,
             )
             return
         from tpuminter.ops import merkle

@@ -525,7 +525,7 @@ def pallas_search_candidates_hdr(
     return row[_FOUND], row[_FIRST_IDX]
 
 
-def _cand_hdr_batch_kernel(n_tiles, tiles_per_step,
+def _cand_hdr_batch_kernel(n_tiles, tiles_per_step, sched,
                            mid_ref, tw_ref, base_ref, lim_ref, cap_ref,
                            out_ref):
     """One grid step = one roll ROW of the batched sweep: identical
@@ -535,7 +535,16 @@ def _cand_hdr_batch_kernel(n_tiles, tiles_per_step,
     output). The valid count is dynamic because rows are the ragged
     ``chain.rolled_tiles`` of an arbitrary global window — the loop
     bound trims to it (a ``valid == 0`` padding row costs zero sweep
-    iterations) and the candidate mask applies it exactly."""
+    iterations) and the candidate mask applies it exactly.
+
+    ``sched=True`` (ISSUE 16) hoists the row's shared message-schedule
+    prefix — rounds 0-2 plus the nonce-free parts of w16-w19 — out of
+    the tile loop via ``sym.prepare_hdr``: everything that depends only
+    on (midstate, merkle word 7, time, bits) is computed once per grid
+    step as 0-d scalars instead of once per tile. Mosaic does not LICM
+    scalar work out of ``while_loop`` bodies on its own, so the hoist
+    must be structural. Same booleans bit for bit (the prepared finisher
+    is pinned against ``hash_sym_e60_e61`` in tier-1)."""
     mid = [mid_ref[0, i] for i in range(8)]
     tail = [tw_ref[0, 0], tw_ref[0, 1], tw_ref[0, 2], 0] + list(
         ops.HEADER_TAIL_PAD
@@ -549,6 +558,7 @@ def _cand_hdr_batch_kernel(n_tiles, tiles_per_step,
     cap1 = cap_ref[0]
     limit = lim_ref[0]  # dynamic i32 valid count, NOT a trace constant
     tile_sz = _TILE[0] * LANES
+    prep = sym.prepare_hdr(mid, tail[0], tail[1], tail[2]) if sched else None
 
     def cond(carry):
         i, found, _ = carry
@@ -560,9 +570,12 @@ def _cand_hdr_batch_kernel(n_tiles, tiles_per_step,
         for t in range(tiles_per_step):
             offs_i = offs + (i + t) * np.int32(tile_sz)
             nonces = base + jax.lax.bitcast_convert_type(offs_i, jnp.uint32)
-            e60, e61 = sym.hash_sym_e60_e61(
-                mid, [tail], ops.HEADER_NONCE_POSITIONS, 0, nonces
-            )
+            if sched:
+                e60, e61 = sym.hash_prepared_e60_e61(prep, nonces)
+            else:
+                e60, e61 = sym.hash_sym_e60_e61(
+                    mid, [tail], ops.HEADER_NONCE_POSITIONS, 0, nonces
+                )
             digest6 = sym.add(sym.DIGEST6_BIAS, e61)
             hw1 = sym.xor(
                 sym.shl(sym.and_(digest6, 0x000000FF), 24),
@@ -589,7 +602,7 @@ def _cand_hdr_batch_kernel(n_tiles, tiles_per_step,
     out_ref[0] = jax.lax.bitcast_convert_type(row, jnp.uint32)
 
 
-@partial(jax.jit, static_argnums=(4, 5))
+@partial(jax.jit, static_argnums=(4, 5, 7))
 def pallas_search_candidates_hdr_batch(
     midstates: jnp.ndarray,
     tailws: jnp.ndarray,
@@ -598,6 +611,7 @@ def pallas_search_candidates_hdr_batch(
     width: int,
     tiles_per_step: int = 8,
     hw1_cap: jnp.ndarray | None = None,
+    sched: bool = False,
 ):
     """Batched twin of :func:`pallas_search_candidates_hdr`: a grid over
     ``B`` roll rows, each sweeping up to ``width`` nonces of ITS OWN
@@ -613,6 +627,11 @@ def pallas_search_candidates_hdr_batch(
     (a ragged or padding row can never surface an out-of-tile
     candidate), so the caller's cross-row fold is a plain masked min
     over ``global_base[row] + first_offs[row]``.
+
+    ``sched=True`` selects the shared-schedule kernel body (see
+    ``_cand_hdr_batch_kernel``): per-row scalar schedule prefix hoisted
+    out of the tile loop, identical results. ``False`` is the exact
+    pre-ISSUE-16 kernel — the bit-for-bit A/B baseline.
     """
     if not 1 <= width <= 1 << 30:
         raise ValueError("width must be in [1, 2^30] (int32 offset domain)")
@@ -625,7 +644,7 @@ def pallas_search_candidates_hdr_batch(
         hw1_cap.astype(jnp.uint32) ^ jnp.uint32(0x80000000), jnp.int32
     )
     summary = pl.pallas_call(
-        partial(_cand_hdr_batch_kernel, n_tiles, tiles_per_step),
+        partial(_cand_hdr_batch_kernel, n_tiles, tiles_per_step, sched),
         out_shape=jax.ShapeDtypeStruct((b,) + _TILE, jnp.uint32),
         grid=(b,),
         in_specs=[

@@ -23,9 +23,16 @@ midstates + (B, 3) tail batches`` — which is what lets a batched sweep
 (``tpuminter.rolled``) cover many extranonce segments per dispatch
 instead of re-entering host orchestration at every segment boundary.
 The scalar :func:`make_extranonce_roll` is the same core at B-of-one.
+:func:`roll_batch_deduped` layers the shared-compression discipline on
+top (ISSUE 16): rows of a window that carry the same extranonce share
+ONE roll evaluation, forked per row by a device gather.
 
 Cost: ``3 + 3·len(branch)`` SHA-256 compressions per extranonce — per
-2^32 nonces of search, i.e. ~1e-9 of the hot-loop work.
+2^32 nonces of search, i.e. ~1e-9 of the hot-loop work. The shared
+sub-computations inside one roll are already single-evaluation: the
+coinbase prefix blocks before the extranonce hole are compressed once
+host-side into the template midstate, and the branch fold runs each
+level as one batched :func:`_dsha256_pair` across all B rows.
 
 Host reference semantics: ``chain.rolled_header`` /
 ``chain.CoinbaseTemplate`` (tests pin the device roll bit-equal).
@@ -44,7 +51,11 @@ import numpy as np
 from tpuminter.chain import HEADER_SIZE, SHA256_H0
 from tpuminter.ops import sha256 as ops
 
-__all__ = ["make_extranonce_roll", "make_extranonce_roll_batch"]
+__all__ = [
+    "make_extranonce_roll",
+    "make_extranonce_roll_batch",
+    "roll_batch_deduped",
+]
 
 _H0 = np.array(SHA256_H0, dtype=np.uint32)
 #: FIPS padding block for a 64-byte message (the merkle pair hash)
@@ -212,3 +223,44 @@ def _cached_batch_roll(header80, coinbase_prefix, coinbase_suffix,
     return jax.jit(_build_roll(
         header80, coinbase_prefix, coinbase_suffix, extranonce_size, branch
     ))
+
+
+def roll_batch_deduped(
+    roll: Callable[[jnp.ndarray, jnp.ndarray], Tuple[jnp.ndarray, jnp.ndarray]],
+    en_hi: np.ndarray,
+    en_lo: np.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Roll each UNIQUE extranonce once and fork the result per row —
+    the ISSUE 16 "compress the shared coinbase prefix once" discipline
+    at whole-roll granularity: in a compute-bound window (nonce span ≥
+    roll_batch × width) every row of the tile plan carries the SAME
+    extranonce, and the plain batched roll re-computes the identical
+    coinbase hash, branch fold, and midstate compress B times.
+
+    Row ``i`` of the output is bit-for-bit the plain
+    ``roll(en_hi, en_lo)`` row ``i``: the roll is elementwise over its
+    batch dim, so rolling the unique set and gathering is the same u32
+    arithmetic per lane (integer ops — no reassociation hazard).
+    Uniques are padded to the next power of two so the jitted roll sees
+    at most ``log2(B)+1`` distinct shapes instead of one per duplicate
+    pattern (the shape-bucketing rule ``rolled.lean_plan`` established).
+
+    Why not the fully-unrolled symbolic roll instead: measured 11x
+    faster steady-state (0.675 → 0.061 ms/call) but ~40 s trace+compile
+    PER JOB vs ~1 s — a job-change latency regression no steady-state
+    win covers at ~30 compressions/window. Recorded as a PERF.md §Round
+    14 rejection; this host-side dedup captures the duplicate-row share
+    of that win with zero new compiled programs.
+    """
+    en = (en_hi.astype(np.uint64) << np.uint64(32)) | en_lo.astype(np.uint64)
+    uniq, inv = np.unique(en, return_inverse=True)
+    if len(uniq) == len(en):
+        return roll(jnp.asarray(en_hi), jnp.asarray(en_lo))
+    n = 1 << max(0, int(len(uniq) - 1).bit_length())
+    padded = np.concatenate([uniq, np.repeat(uniq[:1], n - len(uniq))])
+    mids, tails = roll(
+        jnp.asarray((padded >> np.uint64(32)).astype(np.uint32)),
+        jnp.asarray((padded & np.uint64(0xFFFFFFFF)).astype(np.uint32)),
+    )
+    idx = jnp.asarray(inv.astype(np.int32))
+    return mids[idx], tails[idx]

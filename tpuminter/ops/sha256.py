@@ -47,6 +47,7 @@ __all__ = [
     "HEADER_NONCE_POSITIONS",
     "HEADER_TAIL_PAD",
     "header_digest_dyn",
+    "header_e60_e61_dyn",
     "byteswap32",
     "hash_words_be",
     "lex_le",
@@ -70,10 +71,16 @@ def _round_unroll() -> bool:
     """Unroll the 64 rounds at trace time only where it pays off.
 
     TPU: XLA handles the flat ~7k-op graph fine and straight-line code
-    schedules best. CPU (the CI backend): LLVM chokes on the huge basic
-    block (minutes of compile per template), while a ``lax.scan`` over
-    rounds compiles in seconds and runs vectorized — the right tradeoff
-    for a correctness backend.
+    schedules best. CPU (the CI backend): compile time scales hard with
+    unrolled program size — one or two compressions inside a scan body
+    compile in ~3 s and run ~30x faster than the scanned form (the
+    shared-schedule sweep, PERF.md §Round 14), but stacking their output
+    into a trailing-axis array or chaining ~10 compressions straight-line
+    (the roll: ~40 s/job; the tracking step: 15-42 s) blows the compile
+    budget. The scanned default stays right for this general-purpose
+    entry point, which callers embed many-at-a-time; the sweep-shaped
+    winners opt into the unrolled symbolic form explicitly
+    (:func:`header_e60_e61_dyn`).
     """
     return jax.default_backend() not in ("cpu",)
 
@@ -347,12 +354,15 @@ def header_digest_dyn(
     batch(header_template(header), nonces)`` for the equivalent header
     (tests pin them equal, batched rows included).
 
-    Built on :func:`compress` (scanned on CPU, unrolled on TPU) rather
-    than the symbolic partial-evaluator: with a dynamic midstate there
-    are no constants to fold, and the unrolled form would hit the
-    LLVM-chokes-on-huge-blocks compile cliff on the CI backend. The
-    little-endian nonce bytes at header offset 76 read as a big-endian
-    schedule word are simply ``byteswap(nonce)``.
+    Built on :func:`compress` (scanned on CPU, unrolled on TPU): this
+    full-digest form feeds trailing-axis (N, 8) folds, and stacking the
+    unrolled symbolic form's separate word values into that layout is a
+    measured CPU loss (0.2-4x runtime at 15-42 s compile, PERF.md §Round
+    14 rejection) — the truncated candidate twin
+    (:func:`header_e60_e61_dyn`), which never materializes the stack, is
+    where the unrolled form wins 34x. The little-endian nonce bytes at
+    header offset 76 read as a big-endian schedule word are simply
+    ``byteswap(nonce)``.
     """
     shape = nonces.shape
     tail = jnp.concatenate(
@@ -382,6 +392,40 @@ def header_digest_dyn(
         axis=-1,
     )
     return compress(jnp.broadcast_to(jnp.asarray(_H0), shape + (8,)), block2)
+
+
+def header_e60_e61_dyn(
+    midstate8: jnp.ndarray, tailw3: jnp.ndarray, nonces: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``(e60, e61)`` of the double-SHA for one dynamic header row — the
+    shared-schedule sweep engine (ISSUE 16): digest word 7 is
+    ``SHA256_H0[7] + e60`` and word 6 is ``symbolic.DIGEST6_BIAS + e61``,
+    so the candidate test over the hash's top 64 bits needs nothing else
+    (bit-for-bit ≡ the same test on :func:`header_digest_dyn` output;
+    tier-1 pins it).
+
+    Unlike :func:`header_digest_dyn` this IS built on the symbolic
+    unrolled form — the AsicBoost discipline (arxiv 1604.00575) expressed
+    as lane-level common-subexpression scheduling: every nonce of the
+    sweep collides on ``(midstate, merkle word 7, time, bits)``, so the
+    nonce-free rounds 0-2, schedule words w16/w17, and the scalar parts
+    of w18/w19 stay 0-d (computed once per row, not per lane), constants
+    fold at trace time, the second compression truncates at round 61,
+    and — decisively on this backend — the straight-line rounds dodge the
+    per-round ``lax.scan`` overhead that dominates the scanned compress
+    at sweep widths (measured 34x at 8x256, ~3 s one-time compile per
+    (width, cand_bits) shape; PERF.md §Round 14). The inputs are 0-d u32
+    scalars + a (N,) nonce vector: exactly one row of the batched rolled
+    sweep's ``lax.scan``.
+    """
+    from tpuminter.ops import symbolic as sym
+
+    mid = [midstate8[..., i] for i in range(8)]
+    block = [
+        tailw3[..., 0], tailw3[..., 1], tailw3[..., 2],
+        byteswap32(nonces), *HEADER_TAIL_PAD,
+    ]
+    return sym.hash_sym_e60_e61(mid, [block], (), 0, 0)
 
 
 # ---------------------------------------------------------------------------

@@ -38,6 +38,8 @@ __all__ = [
     "compress_sym_e60_e61",
     "hash_sym_e60_e61",
     "double_sha256_e60_e61",
+    "prepare_hdr",
+    "hash_prepared_e60_e61",
     "CAND_E60",
     "DIGEST6_BIAS",
 ]
@@ -265,6 +267,91 @@ def double_sha256_e60_e61(
         nonce_hi,
         nonce_lo,
     )
+
+
+# ---------------------------------------------------------------------------
+# Shared-schedule header hashing (the AsicBoost discipline, ISSUE 16)
+# ---------------------------------------------------------------------------
+
+#: constant schedule words 4..15 of an 80-byte header's tail block
+#: (≡ ``ops.sha256.HEADER_TAIL_PAD``; duplicated here because this module
+#: must stay importable from ``ops.sha256`` without a cycle)
+_HDR_PAD: Tuple[int, ...] = (0x80000000, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 640)
+
+
+def _bswap32(x: Val) -> Val:
+    """u32 byte swap over the symbolic domain (the little-endian header
+    nonce read as a big-endian schedule word)."""
+    return xor(
+        shl(and_(x, 0x000000FF), 24),
+        shl(and_(x, 0x0000FF00), 8),
+        shr(and_(x, 0x00FF0000), 8),
+        shr(and_(x, 0xFF000000), 24),
+    )
+
+
+def prepare_hdr(
+    midstate: Sequence[Val], t0: Val, t1: Val, t2: Val
+) -> Tuple:
+    """Stage-1 partial evaluation of a header's second block: fold every
+    nonce-INDEPENDENT computation once, so a nonce sweep re-runs only the
+    remainder (:func:`hash_prepared_e60_e61`).
+
+    AsicBoost (arxiv 1604.00575) shares SHA-256 message-schedule work
+    across candidates that collide on the final chunk; here every nonce
+    of a sweep collides on ``(midstate, merkle word 7, time, bits)`` =
+    ``(midstate, t0, t1, t2)``, and the shareable work is exactly:
+
+    - rounds 0-2 (the nonce enters at word 3, so the whole a-h state
+      through round 2 is nonce-free),
+    - schedule words ``w16`` and ``w17`` (their σ-window stops at w1/w2),
+    - the nonce-free partial sums of ``w18`` (missing only σ0(w3)) and
+      ``w19`` (missing only w3).
+
+    Inside a Pallas tile loop these are scalar-unit ops re-executed per
+    tile without this split — Mosaic does not hoist them; the jnp engine
+    gets the same effect for free from 0-d vs lane shapes. Returns an
+    opaque tuple for :func:`hash_prepared_e60_e61`; entries may be ints
+    (baked jobs) or traced u32 scalars (the extranonce-roll consumers).
+    """
+    state = list(midstate)
+    a, b, c, d, e, f, g, h = state
+    for i, wi in enumerate((t0, t1, t2)):
+        r1 = add(h, _Sigma1(e), _ch(e, f, g), SHA256_K[i], wi)
+        r2 = add(_Sigma0(a), _maj(a, b, c))
+        h, g, f, e, d, c, b, a = g, f, e, add(d, r1), c, b, a, add(r1, r2)
+    # w4..w15 are the _HDR_PAD constants: w9 = _HDR_PAD[5], w14 =
+    # _HDR_PAD[10], etc. — the σ terms below fold to ints where possible
+    w16 = add(t0, _sigma0(t1), _HDR_PAD[5], _sigma1(_HDR_PAD[10]))
+    w17 = add(t1, _sigma0(t2), _HDR_PAD[6], _sigma1(_HDR_PAD[11]))
+    p18 = add(t2, _HDR_PAD[7], _sigma1(w16))  # + σ0(w3) at sweep time
+    p19 = add(_sigma0(_HDR_PAD[0]), _HDR_PAD[8], _sigma1(w17))  # + w3
+    return (tuple(state), (a, b, c, d, e, f, g, h), w16, w17, p18, p19)
+
+
+def hash_prepared_e60_e61(prep: Tuple, nonce: Val) -> Tuple[Val, Val]:
+    """Stage-2 of the shared-schedule header hash: finish the first
+    compression from a :func:`prepare_hdr` stage and run the truncated
+    second compression. ≡ ``hash_sym_e60_e61(midstate, [tail],
+    HEADER_NONCE_POSITIONS, 0, nonce)`` bit-for-bit (pinned by tier-1),
+    with the stage-1 work amortized across every call sharing ``prep``.
+    """
+    midstate, vars8, w16, w17, p18, p19 = prep
+    w3 = _bswap32(nonce)
+    w: List[Val] = [
+        None, None, None, w3, *_HDR_PAD,  # w0..w2 dead past round 2
+        w16, w17, add(p18, _sigma0(w3)), add(p19, w3),
+    ]
+    for i in range(20, 64):
+        w.append(schedule_word(w, i))
+    a, b, c, d, e, f, g, h = vars8
+    for i in range(3, 64):
+        r1 = add(h, _Sigma1(e), _ch(e, f, g), SHA256_K[i], w[i])
+        r2 = add(_Sigma0(a), _maj(a, b, c))
+        h, g, f, e, d, c, b, a = g, f, e, add(d, r1), c, b, a, add(r1, r2)
+    state = [add(s, v) for s, v in zip(midstate, (a, b, c, d, e, f, g, h))]
+    w2: List[Val] = list(state) + [0x80000000, 0, 0, 0, 0, 0, 0, 256]
+    return compress_sym_e60_e61([int(x) for x in SHA256_H0], w2)
 
 
 def inject_nonce_bytes(

@@ -44,8 +44,9 @@ min-fold machinery gets exercised at toy difficulty.
 from __future__ import annotations
 
 import struct
+import time
 from functools import lru_cache, partial
-from typing import Callable, Dict, Iterator, NamedTuple, Optional, Tuple
+from typing import Callable, Dict, Iterator, NamedTuple, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -64,6 +65,7 @@ __all__ = [
     "rolled_verifier",
     "mine_rolled_fast",
     "mine_rolled_tracking",
+    "autotune_width",
     "ProgressFn",
     "report_search_progress",
 ]
@@ -251,26 +253,54 @@ def _jnp_candidate_ok(digests, cap, cand_bits: int):
     return (hw0 >> np.uint32(32 - cand_bits)) == 0
 
 
-@partial(jax.jit, static_argnums=(6, 7))
+def _jnp_candidate_ok_sched(mid, tw, nonces, cap, cand_bits: int):
+    """The same candidate test from the shared-schedule truncated hash
+    (ISSUE 16): digest word 7 = ``H0[7] + e60`` and word 6 =
+    ``DIGEST6_BIAS + e61``, so the two words :func:`_jnp_candidate_ok`
+    byteswaps are recovered exactly — same booleans, bit for bit — while
+    the sweep skips the final rounds, the a-chain of rounds 58-61, the
+    8 digest adds, and the whole (N, 8) digest materialization."""
+    from tpuminter.ops import symbolic as sym
+
+    e60, e61 = ops.header_e60_e61_dyn(mid, tw, nonces)
+    hw0 = ops.byteswap32(sym.add(e60, int(ops.SHA256_H0[7])))
+    if cand_bits == 32:
+        hw1 = ops.byteswap32(sym.add(e61, sym.DIGEST6_BIAS))
+        return (hw0 == 0) & (hw1 <= cap)
+    return (hw0 >> np.uint32(32 - cand_bits)) == 0
+
+
+@partial(jax.jit, static_argnums=(6, 7, 8))
 def _jnp_batched_candidate_sweep(
-    mids, tails, bases, valids, goffs, cap, width: int, cand_bits: int
+    mids, tails, bases, valids, goffs, cap, width: int, cand_bits: int,
+    sched: bool = False,
 ):
     """jnp mirror of ``pallas_search_candidates_hdr_batch`` + the
     cross-row fold, one program: (R, width) nonces under R dynamic
     headers → ``[found, first_global_off]``. Compiled once per (width,
-    cand_bits) — nothing job-specific is baked.
+    cand_bits, sched) — nothing job-specific is baked.
 
     Rows run SEQUENTIALLY inside the program (``lax.scan``), mirroring
     the Pallas kernel's grid-over-rows: on the CPU engine a flat
     (R·width)-lane program blows the cache and costs ~50% more per hash
     (measured: 3.15 → 4.86 µs at 8×256), while per-row working sets
-    stay cache-sized and the dispatch count still drops ~B×."""
+    stay cache-sized and the dispatch count still drops ~B×.
+
+    ``sched=True`` swaps the per-row hash for the shared-schedule
+    truncated form (:func:`_jnp_candidate_ok_sched`): identical fold,
+    identical booleans, measured ~34× per-hash on this CPU at 8×256
+    (PERF.md §Round 14). ``False`` is the bit-for-bit A/B baseline —
+    the exact pre-ISSUE-16 program."""
     col = jnp.arange(width, dtype=jnp.uint32)
 
     def row(carry, x):
         mid, tw, base, valid, goff = x
-        digests = ops.header_digest_dyn(mid, tw, base + col)
-        ok = _jnp_candidate_ok(digests, cap, cand_bits) & (col < valid)
+        if sched:
+            ok = _jnp_candidate_ok_sched(mid, tw, base + col, cap, cand_bits)
+        else:
+            digests = ops.header_digest_dyn(mid, tw, base + col)
+            ok = _jnp_candidate_ok(digests, cap, cand_bits)
+        ok = ok & (col < valid)
         g = jnp.where(ok, goff + col, _UMAX)
         found, first = carry
         return (found | ok.any(), jnp.minimum(first, jnp.min(g))), None
@@ -282,16 +312,19 @@ def _jnp_batched_candidate_sweep(
     return jnp.stack([found.astype(jnp.uint32), first])
 
 
-@partial(jax.jit, static_argnums=(6, 7))
+@partial(jax.jit, static_argnums=(6, 7, 8))
 def _pallas_batched_candidate_sweep(
-    mids, tails, bases, valids, goffs, cap, width: int, tiles_per_step: int
+    mids, tails, bases, valids, goffs, cap, width: int, tiles_per_step: int,
+    sched: bool = False,
 ):
     """Pallas engine: the batched dynamic-header kernel (one launch
-    grids over roll rows) + the same cross-row fold."""
+    grids over roll rows) + the same cross-row fold. ``sched=True``
+    selects the shared-schedule kernel variant (per-row scalar prefix
+    hoisted out of the tile loop via ``sym.prepare_hdr``)."""
     from tpuminter.kernels import pallas_search_candidates_hdr_batch
 
     founds, firsts = pallas_search_candidates_hdr_batch(
-        mids, tails, bases, valids, width, tiles_per_step, cap
+        mids, tails, bases, valids, width, tiles_per_step, cap, sched=sched
     )
     ok = founds != 0
     g = jnp.where(ok, goffs + firsts, _UMAX)
@@ -308,6 +341,73 @@ def _jnp_segment_candidate_sweep(mid, tail, base, cap, width: int, cand_bits: in
     ok = _jnp_candidate_ok(digests, cap, cand_bits)
     off = jnp.where(ok, jnp.arange(width, dtype=jnp.uint32), _UMAX)
     return jnp.stack([ok.any().astype(jnp.uint32), jnp.min(off)])
+
+
+# ---------------------------------------------------------------------------
+# width autotune: one-shot cached startup probe
+# ---------------------------------------------------------------------------
+
+#: (backend, candidates, cand_bits, sched_share, rows) -> winning width.
+#: Process-lifetime cache: the probe costs one compile + a few dispatches
+#: per candidate width, so it runs at most once per configuration.
+_autotune_cache: Dict[Tuple, int] = {}
+
+
+def autotune_width(
+    candidates: Tuple[int, ...] = (128, 256, 512, 1024),
+    *,
+    cand_bits: int = 32,
+    sched_share: bool = True,
+    rows: int = 8,
+    reps: int = 3,
+) -> int:
+    """One-shot startup probe: time :func:`_jnp_batched_candidate_sweep`
+    over dummy data at each candidate ``width`` and return the one with
+    the best per-hash rate. Cached per (backend, candidates, cand_bits,
+    sched_share, rows) for the life of the process — callers pay the
+    probe once, then every ``width="auto"`` miner reads the dict.
+
+    The probe is deliberately tiny (min-of-``reps`` after one warm
+    call): it ranks widths against each other on THIS backend rather
+    than measuring absolute throughput, so a handful of dispatches is
+    enough to separate cache-sized from cache-blowing row widths. The
+    explicit ``width=`` knob on :func:`mine_rolled_fast` remains the
+    A/B override — autotune never forces a choice on callers that pin
+    one."""
+    key = (jax.default_backend(), tuple(candidates), cand_bits,
+           bool(sched_share), rows)
+    hit = _autotune_cache.get(key)
+    if hit is not None:
+        return hit
+
+    rng = np.random.RandomState(0)
+    cap = jnp.uint32(0)
+    best_width, best_rate = candidates[0], -1.0
+    for width in candidates:
+        mids = jnp.asarray(rng.randint(0, 1 << 32, (rows, 8), dtype=np.uint32))
+        tails = jnp.asarray(rng.randint(0, 1 << 32, (rows, 3), dtype=np.uint32))
+        bases = jnp.asarray(rng.randint(0, 1 << 20, rows, dtype=np.uint32))
+        valids = jnp.asarray(np.full(rows, width, np.uint32))
+        goffs = jnp.asarray((np.arange(rows, dtype=np.uint64) * width)
+                            .astype(np.uint32))
+        args = (mids, tails, bases, valids, goffs, cap, width, cand_bits,
+                sched_share)
+        _jnp_batched_candidate_sweep(*args).block_until_ready()  # compile
+        dt = min(
+            _timed_call(_jnp_batched_candidate_sweep, args)
+            for _ in range(max(1, reps))
+        )
+        rate = rows * width / dt
+        if rate > best_rate:
+            best_width, best_rate = width, rate
+    _autotune_cache[key] = best_width
+    return best_width
+
+
+def _timed_call(fn, args) -> float:
+    t0 = time.perf_counter()
+    fn(*args).block_until_ready()
+    return time.perf_counter() - t0
 
 
 # ---------------------------------------------------------------------------
@@ -337,6 +437,8 @@ def mine_rolled_fast(
     engine: str = "auto",
     tiles_per_step: int = 8,
     cand_bits: int = 32,
+    sched_share: bool = True,
+    width: Optional[Union[int, str]] = None,
     counters: Optional[Dict[str, int]] = None,
     progress: Optional[ProgressFn] = None,
 ) -> Iterator[Optional[Result]]:
@@ -347,6 +449,19 @@ def mine_rolled_fast(
     boundary, BASELINE.json:9-10). ``roll_batch=1`` is the A/B
     baseline: the pre-batching per-segment loop, one ``CandidateSearch``
     and one scalar roll per extranonce segment.
+
+    ``sched_share`` (ISSUE 16) turns on the AsicBoost-grade shared-
+    schedule layer: the sweep hashes through the truncated unrolled
+    second compression (:func:`_jnp_candidate_ok_sched`, ~34× per hash
+    measured on CPU) and the batched roll dedupes identical extranonce
+    rows before dispatch (:func:`tpuminter.ops.merkle.roll_batch_deduped`).
+    ``sched_share=False`` is the bit-for-bit A/B baseline — the exact
+    pre-ISSUE-16 programs (house rule since PR 7).
+
+    ``width`` overrides the sweep row width: ``None`` keeps the legacy
+    cap-derived ``tile_width(nonce_bits, slab)``; ``"auto"`` caps it at
+    the :func:`autotune_width` probe winner; an int caps it explicitly
+    (all still clamped by ``slab`` and the nonce space).
 
     ``counters`` (optional dict) accumulates ``rolls``/``sweeps`` —
     device dispatch evidence for bench.py's rolled A/B fields.
@@ -367,7 +482,13 @@ def mine_rolled_fast(
         )
         return
 
-    width = tile_width(req.nonce_bits, slab)
+    cap = slab
+    if width == "auto":
+        cap = min(slab, autotune_width(
+            cand_bits=cand_bits, sched_share=sched_share, rows=roll_batch))
+    elif width is not None:
+        cap = min(slab, int(width))
+    width = tile_width(req.nonce_bits, cap)
     rows = roll_batch + 2
     window = roll_batch * width
     if window >= 1 << 32:
@@ -385,16 +506,21 @@ def mine_rolled_fast(
         )
         _count(counters, "rolls")
         _count(counters, "sweeps")
-        mids, tails = roll(jnp.asarray(plan.en_hi), jnp.asarray(plan.en_lo))
+        if sched_share:
+            mids, tails = merkle.roll_batch_deduped(
+                roll, plan.en_hi, plan.en_lo)
+        else:
+            mids, tails = roll(
+                jnp.asarray(plan.en_hi), jnp.asarray(plan.en_lo))
         args = (
             mids, tails, jnp.asarray(plan.bases), jnp.asarray(plan.valids),
             jnp.asarray(plan.goffs), hw1_cap,
         )
         if engine == "pallas":
             return _pallas_batched_candidate_sweep(
-                *args, width, tiles_per_step
+                *args, width, tiles_per_step, sched_share
             )
-        return _jnp_batched_candidate_sweep(*args, width, cand_bits)
+        return _jnp_batched_candidate_sweep(*args, width, cand_bits, sched_share)
 
     search = CandidateSearch(
         sweep, resolve_handle, verify, req.lower, req.upper,
@@ -545,6 +671,7 @@ def mine_rolled_tracking(
     width_cap: int = 1 << 14,
     depth: int = 2,
     roll_batch: int = 8,
+    sched_share: bool = True,
     counters: Optional[Dict[str, int]] = None,
     progress: Optional[ProgressFn] = None,
 ) -> Iterator[Optional[Result]]:
@@ -557,6 +684,16 @@ def mine_rolled_tracking(
     toy-easy-target correctness path plus JaxMiner's production rolled
     path. Batched rows ≡ the per-segment loop bit-for-bit
     (tests/test_extranonce.py pins it).
+
+    ``sched_share`` here buys ONLY the roll-side dedup
+    (:func:`tpuminter.ops.merkle.roll_batch_deduped`): the tracking
+    step itself keeps the scanned full-digest compress. Sharing the
+    unrolled schedule inside the full-digest + lexicographic-min fold
+    was measured and REJECTED — every fold structure tried either lost
+    outright or paid a 15-42 s compile per width (PERF.md §Round 14);
+    the truncated e60/e61 trick doesn't apply when all 8 digest words
+    feed the min fold. ``False`` restores the exact pre-ISSUE-16 roll
+    dispatch for A/B.
     """
     assert req.rolled and req.target is not None
     from tpuminter.ops import merkle
@@ -581,7 +718,12 @@ def mine_rolled_tracking(
         )
         _count(counters, "rolls")
         _count(counters, "sweeps")
-        mids, tails = roll(jnp.asarray(plan.en_hi), jnp.asarray(plan.en_lo))
+        if sched_share:
+            mids, tails = merkle.roll_batch_deduped(
+                roll, plan.en_hi, plan.en_lo)
+        else:
+            mids, tails = roll(
+                jnp.asarray(plan.en_hi), jnp.asarray(plan.en_lo))
         return _tracking_step(
             mids, tails, jnp.asarray(plan.bases), jnp.asarray(plan.valids),
             jnp.asarray(plan.goffs), target_words, width,

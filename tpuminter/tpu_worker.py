@@ -112,6 +112,7 @@ class TpuMiner(Miner):
         depth: int = DEFAULT_DEPTH,
         exact_min: bool = False,
         roll_batch: int = 8,
+        sched_share: bool = True,
     ):
         if jax.default_backend() == "cpu":
             raise RuntimeError(
@@ -125,6 +126,11 @@ class TpuMiner(Miner):
         #: batched roll + batched dynamic-header kernel sweep many
         #: segments per launch; 1 = the per-segment A/B baseline
         self.roll_batch = roll_batch
+        #: ISSUE 16 schedule-sharing layer on the rolled path: the
+        #: shared-schedule kernel body (sym.prepare_hdr hoist) for the
+        #: fast sweep + the extranonce-roll dedup on both rolled paths.
+        #: False restores the exact pre-ISSUE-16 programs for A/B.
+        self.sched_share = sched_share
         self._scrypt_delegate = None
         # scheduler hint: ask for chunks a few slabs deep
         self.lanes = lanes if lanes is not None else (slab * 4) // 16_384
@@ -200,7 +206,7 @@ class TpuMiner(Miner):
         yield from rolled.mine_rolled_fast(
             req, slab=self.slab, depth=self.depth,
             roll_batch=self.roll_batch, engine="pallas",
-            progress=self.progress_cb,
+            sched_share=self.sched_share, progress=self.progress_cb,
         )
 
     def _mine_rolled_tracking(self, req: Request) -> Iterator[Optional[Result]]:
@@ -219,7 +225,8 @@ class TpuMiner(Miner):
 
             yield from rolled.mine_rolled_tracking(
                 req, width_cap=min(self.slab, 1 << 16), depth=self.depth,
-                roll_batch=self.roll_batch, progress=self.progress_cb,
+                roll_batch=self.roll_batch, sched_share=self.sched_share,
+                progress=self.progress_cb,
             )
             return
         cb = chain.CoinbaseTemplate(
