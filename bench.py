@@ -1273,6 +1273,94 @@ def bench_workload(duration: float = 1.5, smoke: bool = False) -> dict:
     }
 
 
+def bench_workload_dev(
+    duration: float = 1.0,
+    smoke: bool = False,
+    shapes: tuple = (4096, 65536),
+) -> dict:
+    """Device-lane hashcore A/B (ISSUE 17): the SAME fmin chunk driven
+    through ``HashCore.compute`` twice — numpy host lanes
+    (``dev_lanes=off``, the shipped baseline) vs the u32-pair device
+    engine (``ops.splitmix``) — at ≥2 batch shapes, so the crossover
+    (dispatch overhead vs in-program fold win) is a number per shape.
+
+    - ``workload_dev_host_ips_{n}`` / ``workload_dev_ips_{n}`` —
+      indices/s per arm at chunk size n (paired, same process).
+    - ``workload_dev_speedup_pct_{n}`` — device over host.
+    - ``workload_dev_equal`` — every measured pair of (searched, acc)
+      compared bit-for-bit; False poisons the capture by design.
+    - ``workload_dev_width`` / ``workload_dev_engine`` — the resolved
+      sweep shape (smoke pins width to keep tier-1 compile cost at one
+      program; full captures use the autotune probe winner).
+    """
+    from tpuminter.protocol import PowMode, Request
+    from tpuminter.workloads import hashcore as hc
+
+    core = hc.HashCore()
+
+    def drive(req, fold, engine):
+        gen = core.compute(req, fold, engine=engine)
+        while True:
+            try:
+                next(gen)
+            except StopIteration as stop:
+                return stop.value
+
+    if smoke:
+        shapes = (4096, 16384)
+    out: dict = {}
+    equal = True
+    prior = hc.set_dev_lanes(
+        "off", width=2048 if smoke else None, rows=2 if smoke else None
+    )
+    try:
+        for n in shapes:
+            req = Request(
+                job_id=1, mode=PowMode.MIN, lower=0, upper=n - 1,
+                data=hc.pack_params("fmin", seed=0xBEEF ^ n),
+                workload="hashcore",
+            )
+            fold = core.fold_for(req)
+            rates = {}
+            for arm, mode in (("host", "off"), ("dev", "on")):
+                hc.set_dev_lanes(mode)
+                want = drive(req, fold, "jax")  # warm (compile) + truth
+                done = 0
+                t0 = time.perf_counter()
+                while time.perf_counter() - t0 < duration:
+                    got = drive(req, fold, "jax")
+                    equal = equal and got == want
+                    done += n
+                rates[arm] = done / (time.perf_counter() - t0)
+            out[f"workload_dev_host_ips_{n}"] = round(rates["host"], 1)
+            out[f"workload_dev_ips_{n}"] = round(rates["dev"], 1)
+            out[f"workload_dev_speedup_pct_{n}"] = round(
+                (rates["dev"] / rates["host"] - 1.0) * 100.0, 1
+            )
+        from tpuminter.ops import splitmix
+
+        sweep = splitmix.lane_sweep(
+            "fmin",
+            **{
+                k: v
+                for k, v in (
+                    ("width", hc.dev_lanes_config()["width"]),
+                    ("rows", hc.dev_lanes_config()["rows"]),
+                )
+                if v is not None
+            },
+        )
+        out["workload_dev_width"] = sweep.width
+        out["workload_dev_engine"] = sweep.engine
+        out["workload_dev_equal"] = equal
+    finally:
+        hc.set_dev_lanes(
+            prior["mode"], width=prior["width"], rows=prior["rows"],
+            engine=prior["engine"],
+        )
+    return out
+
+
 def bench_native(seconds: float = 2.0) -> dict:
     """Measured native C++ double-SHA rate (README's backend table row;
     BASELINE.md quoted 1.84 MH/s on this host). Absent .so → empty."""
@@ -1343,6 +1431,7 @@ def main() -> None:
         extra.update(bench_rolled(pairs=1, nb_points=(8,)))
         extra.update(bench_rolled_cp(duration=1.0, smoke=True))
         extra.update(bench_workload(duration=1.0, smoke=True))
+        extra.update(bench_workload_dev(duration=0.5, smoke=True))
         extra.update(bench_native(seconds=0.5))
     elif jax.default_backend() == "cpu":
         # the TPU tunnel is down and jax silently fell back to CPU: say
@@ -1363,6 +1452,7 @@ def main() -> None:
         extra.update(bench_rolled())
         extra.update(bench_rolled_cp())
         extra.update(bench_workload())
+        extra.update(bench_workload_dev())
         extra.update(bench_native())
     else:
         # persistent compilation cache, same as the worker CLI: the
@@ -1398,6 +1488,7 @@ def main() -> None:
         extra.update(bench_rolled())
         extra.update(bench_rolled_cp())
         extra.update(bench_workload())
+        extra.update(bench_workload_dev())
         extra.update(bench_native())
     ghs = rate / 1e9
     print(

@@ -1788,6 +1788,7 @@ async def run_workload(
     drain: float = 10.0,
     binary: bool = True,
     pipeline_depth: int = 2,
+    dev_lanes: bool = False,
 ) -> dict:
     """The pluggable-workload drill (ISSUE 15): REAL CpuMiner workers
     (the hashcore compute seam, not the instant-answer fleet) serve
@@ -1806,10 +1807,29 @@ async def run_workload(
     decoded accumulator is checked against the exact locally-computed
     answer for its fold, so a replayed settle, a lost partial, or a
     double-counted non-idempotent fold (fsum) surfaces as
-    ``answers_wrong`` even when delivery itself was exactly-once."""
+    ``answers_wrong`` even when delivery itself was exactly-once.
+
+    ``dev_lanes=True`` runs the SAME drill with the hashcore compute
+    forced onto the u32-pair device-lane engine (ISSUE 17): the fleet's
+    answers must be identical — the ledger's exact-value checks ARE the
+    device/host equality gate, now under crash + failover — and the
+    drill additionally proves the device engine actually ran
+    (``dev_dispatches`` from ``ops.splitmix.counters``)."""
     import shutil
 
     from tpuminter.worker import CpuMiner, run_miner_reconnect
+    from tpuminter.workloads import hashcore as _hc
+
+    dev_prior = None
+    dev_dispatch0 = 0
+    if dev_lanes:
+        # pinned small width = one cheap compile per variant per
+        # process (the tests reuse the same shape); rows=2 keeps the
+        # window smaller than a chunk so pipelining actually engages
+        dev_prior = _hc.set_dev_lanes("on", width=512, rows=2)
+        from tpuminter.ops import splitmix as _sm
+
+        dev_dispatch0 = _sm.counters["dispatches"]
 
     tmpdir = None
     if journal_path is None:
@@ -1913,8 +1933,20 @@ async def run_workload(
         metrics["results_rejected"] = coord.stats["results_rejected"]
         if coord._journal is not None:
             metrics["journal"] = dict(coord._journal.stats)
+        metrics["dev_lanes"] = dev_lanes
+        if dev_lanes:
+            from tpuminter.ops import splitmix as _sm
+
+            metrics["dev_dispatches"] = (
+                _sm.counters["dispatches"] - dev_dispatch0
+            )
         return metrics
     finally:
+        if dev_prior is not None:
+            _hc.set_dev_lanes(
+                dev_prior["mode"], width=dev_prior["width"],
+                rows=dev_prior["rows"], engine=dev_prior["engine"],
+            )
         for t in clients + miners:
             t.cancel()
         await asyncio.gather(*clients, *miners, return_exceptions=True)
@@ -1972,6 +2004,12 @@ def workload_check(metrics: dict) -> list:
         bad.append(
             "fleet did not resume within 10 s of the restart: "
             f"{metrics.get('restart_to_first_assign_ms')} ms"
+        )
+    if metrics.get("dev_lanes") and metrics.get("dev_dispatches", 0) <= 0:
+        bad.append(
+            "dev_lanes drill never dispatched a device-lane sweep — the "
+            "answers above were computed by the host fallback, so the "
+            "device/host equality claim is vacuous"
         )
     return bad
 
@@ -3316,6 +3354,13 @@ def main(argv=None) -> int:
         "a failing matrix replays cell-for-cell",
     )
     parser.add_argument(
+        "--dev-lanes", action="store_true",
+        help="workload scenario: force the hashcore fleet onto the "
+        "u32-pair device-lane engine (ops.splitmix) — same drill, same "
+        "exact-answer ledger, plus a gate that the device engine "
+        "demonstrably dispatched (ISSUE 17's crash-safe equality leg)",
+    )
+    parser.add_argument(
         "--journal", metavar="PATH", default=None,
         help="journal file (steady: measures journaling overhead; "
         "crash: defaults to a temp file)",
@@ -3512,6 +3557,7 @@ def main(argv=None) -> int:
             else args.duration,
             binary=args.codec == "binary",
             pipeline_depth=args.pipeline,
+            dev_lanes=args.dev_lanes,
         ))
         print(json.dumps(metrics) if args.json else
               "\n".join(f"{k}: {v}" for k, v in metrics.items()))

@@ -1,0 +1,52 @@
+"""True-positive fixture: an uncached device-lane sweep factory.
+
+The ISSUE 17 hazard variant of the pre-PR-7 bug class, shaped like the
+new splitmix device-lane engine: a dispatch helper that rebuilds the
+``jax.jit`` sweep program (and the Pallas lane kernel inside it) on
+every window. The u32-pair arithmetic is cheap to trace once, but a
+fmin sweep over millions of windows re-traces the whole scan body per
+dispatch, and the engine's entire point — amortize one compile across a
+job's constant (variant, width, rows, k) — never happens. Also carries
+the sibling hazard: the fold's accumulator passed as a list into an
+``lru_cache``'d factory, silently defeating the cache at runtime.
+Parsed by tests/test_analysis.py, never imported.
+"""
+
+from functools import lru_cache
+
+import jax
+from jax.experimental import pallas as pl
+
+
+def lane_dispatch(seed_words, base_words, width):
+    # rebuilt per window: the scan body (and its fold) re-traces on
+    # every dispatch even though (variant, width, rows, k) never change
+    # within a job
+    sweep = jax.jit(lambda s, b: _fmin_scan(s, b, width))
+    return sweep(seed_words, base_words)
+
+
+def lane_kernel(idx_hi, idx_lo):
+    # same bug one layer down: a fresh pallas_call per batch means
+    # Mosaic recompiles the lane kernel every time the worker hops jobs
+    call = pl.pallas_call(_splitmix_body, out_shape=idx_hi)
+    return call(idx_hi, idx_lo)
+
+
+@lru_cache(maxsize=64)
+def build_lane_sweep(variant, width, rows, k):
+    return jax.jit(lambda s, b: _fmin_scan(s, b, width))
+
+
+def resolve_window(seed_words, base_words):
+    # unhashable argument defeats the factory cache at runtime: every
+    # window builds (and traces) a brand-new sweep program
+    return build_lane_sweep("fmin", 4096, [8], 1)(seed_words, base_words)
+
+
+def _fmin_scan(seed_words, base_words, width):
+    return seed_words[0] + base_words[0] + width
+
+
+def _splitmix_body(ih_ref, il_ref, o_ref):
+    o_ref[...] = ih_ref[...] ^ il_ref[...]

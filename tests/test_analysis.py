@@ -150,6 +150,32 @@ def test_retrace_catches_uncached_sched_factory():
     )
 
 
+def test_retrace_catches_uncached_splitmix_factory():
+    """ISSUE 17 hazard variant: a device-lane sweep whose jit program
+    (or Pallas lane kernel) is rebuilt per window re-traces the scan
+    body on every dispatch — `tpuminter.analysis` must flag it (the
+    splitmix engine's sweep_program/pallas_splitmix_batch are cached
+    precisely for this)."""
+    findings = _fixture_findings(
+        "uncached_splitmix_factory.py", ["retrace-hazard"]
+    )
+    assert any(
+        f.qualname == "lane_dispatch" and f.symbol == "jax.jit"
+        for f in findings
+    )
+    assert any(
+        f.qualname == "lane_kernel" and f.symbol == "pl.pallas_call"
+        for f in findings
+    )
+    # the cached factory is the FIX — it must stay quiet...
+    assert not any(f.qualname == "build_lane_sweep" for f in findings)
+    # ...but the list literal defeating it at the call site must be loud
+    assert any(
+        f.qualname == "resolve_window" and "unhashable" in f.message
+        for f in findings
+    )
+
+
 def test_thread_seam_catches_cross_loop_write():
     findings = _fixture_findings("cross_loop_write.py", ["thread-seam"])
     assert any(

@@ -78,3 +78,16 @@ def test_bench_smoke_emits_one_json_line():
     assert obj["extra"]["workload_jobs_per_s_hashcore"] > 0
     assert obj["extra"]["workload_indices_per_s_hashcore"] > 0
     assert obj["extra"]["workload_folds_covered"] == 4
+    # the device-lane hashcore A/B rides every capture (ISSUE 17):
+    # host and device arms both measured at BOTH batch shapes, the
+    # paired outputs verified bit-for-bit during the measurement, and
+    # the resolved sweep shape recorded
+    for n in (4096, 16384):
+        assert obj["extra"][f"workload_dev_host_ips_{n}"] > 0
+        assert obj["extra"][f"workload_dev_ips_{n}"] > 0
+        assert isinstance(
+            obj["extra"][f"workload_dev_speedup_pct_{n}"], (int, float)
+        )
+    assert obj["extra"]["workload_dev_equal"] is True
+    assert obj["extra"]["workload_dev_width"] % 128 == 0
+    assert obj["extra"]["workload_dev_engine"] in ("jnp", "pallas")

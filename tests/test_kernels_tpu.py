@@ -342,6 +342,81 @@ r = drain(jm.mine(req))
 assert r.found and (r.nonce, r.hash_value) == (n_min, h_min)
 print("SECTION-OK")
 """,
+    # --- device-lane hashcore engine on silicon (ISSUE 17): the Pallas
+    # splitmix kernel compiled by Mosaic (CPU CI only ever interprets
+    # it), the pallas-engine sweep programs bit-exact vs the scalar
+    # objective at compiled shapes, and the full compute seam under the
+    # dev_lanes knob — plus a fresh on-HBM width autotune probe
+    "hashcore_dev": r"""
+from tpuminter.kernels.splitmix import pallas_splitmix_batch
+from tpuminter.ops import splitmix as sm
+from tpuminter.workloads import hashcore as hc
+from tpuminter.workloads import folds
+
+rng = np.random.default_rng(17)
+idx = rng.integers(0, 1 << 64, 4096, dtype=np.uint64)
+ih = (idx >> np.uint64(32)).astype(np.uint32)
+il = (idx & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+seed = 0xFEED_FACE_CAFE_F00D
+vh, vl = pallas_splitmix_batch(
+    np.uint32(seed >> 32), np.uint32(seed & 0xFFFFFFFF),
+    jnp.asarray(ih), jnp.asarray(il))
+vh, vl = np.asarray(vh), np.asarray(vl)
+for i in [0, 1, 777, 4095]:
+    want = hc.objective(seed, int(idx[i]))
+    assert (int(vh[i]) << 32) | int(vl[i]) == want, f"splitmix {i}"
+
+# pallas-engine sweep ≡ host folds at a compiled (non-interpret) shape
+lo, hi = (1 << 40) + 3, (1 << 40) + 3 + 50_000
+vals = [hc.objective(seed, g) for g in range(lo, hi + 1)]
+for variant, fold, thr, k in [
+    ("fmin", folds.FMin(), 0, 1),
+    ("topk", folds.TopK(5), 0, 5),
+    ("fmatch", folds.FirstMatch(sorted(vals)[3]), sorted(vals)[3], 1),
+    ("fsum", folds.FSum(), 0, 1),
+]:
+    sweep = sm.LaneSweep(variant, 2048, 8, k, "pallas")
+    acc = fold.initial()
+    g = lo
+    while g <= hi:
+        e = min(g + sweep.window - 1, hi)
+        acc = fold.combine(
+            acc, sweep.resolve(sweep.dispatch(seed, g, e, thr), g, e))
+        if fold.is_final(acc):
+            break
+        g = e + 1
+    host = fold.of_batch(lo, vals)
+    assert acc == host, (variant, acc, host)
+
+# the compute seam end to end on the default (auto) knob: a tpu-backend
+# worker routes through device lanes and matches the host answer
+def drive_gen(gen):
+    while True:
+        try:
+            next(gen)
+        except StopIteration as stop:
+            return stop.value
+
+core = hc.HashCore()
+req = Request(job_id=1, mode=PowMode.MIN, lower=0, upper=200_000,
+              data=hc.pack_params("fmin", seed=seed), workload="hashcore",
+              chunk_id=0)
+fold = core.fold_for(req)
+hc.set_dev_lanes("off")
+want = drive_gen(core.compute(req, fold, engine="tpu"))
+hc.set_dev_lanes("auto")
+before = sm.counters["dispatches"]
+got = drive_gen(core.compute(req, fold, engine="tpu"))
+assert sm.counters["dispatches"] > before  # device lanes demonstrably ran
+assert got == want
+
+# on-HBM width autotune: a real probe on this chip's memory system
+sm._autotune_cache.clear()
+w = sm.autotune_lane_width("pallas", rows=8)
+assert w in (2048, 4096, 8192, 16384)
+print("AUTOTUNE-WIDTH", w)
+print("SECTION-OK")
+""",
     # --- pod SCRYPT sweep on silicon (VERDICT r4 missing #1): the
     # shard_map'd scrypt pipeline + winner/min ICI folds on the 1-chip
     # mesh — winner, exhausted-minimum, and the ragged single-chip tail,

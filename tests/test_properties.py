@@ -1047,6 +1047,66 @@ def test_fold_payload_roundtrip_and_corruption(
             fold.decode(bytes(bad))
 
 
+# ---------------------------------------------------------------------------
+# device-lane hashcore engine (ISSUE 17): the u32-pair sweep must be
+# bit-for-bit the host fold chain on ARBITRARY (seed, range, fold) —
+# the hypothesis mirror of tests/test_hashcore_dev.py's seeded pins
+# ---------------------------------------------------------------------------
+
+from tpuminter.ops import splitmix as _sm  # noqa: E402
+
+
+@settings(max_examples=100, deadline=None)
+@given(seed=st.integers(0, 2**64 - 1), index=st.integers(0, 2**64 - 1))
+def test_u32_pair_objective_matches_scalar(seed, index):
+    """The hi/lo-word splitmix64 (carry adds, 16-bit-limb multiplies,
+    cross-word shifts) agrees with the Python-int scalar at every
+    (seed, index) hypothesis can throw at it — shrinking lands on the
+    exact carry/shift boundary if one is off."""
+    assert _sm.lane_objective(seed, [index]) == [
+        _hc.objective(seed, index)
+    ]
+
+
+_DEV_MAKERS = (
+    lambda thr, k: (FMin(), "fmin", 1),
+    lambda thr, k: (TopK(k), "topk", k),
+    lambda thr, k: (FirstMatch(thr), "fmatch", 1),
+    lambda thr, k: (FSum(), "fsum", 1),
+)
+
+
+@settings(max_examples=25, deadline=None)
+@given(
+    fold_i=st.integers(0, len(_DEV_MAKERS) - 1),
+    seed=st.integers(0, 2**64 - 1),
+    lo=st.integers(0, 2**63),
+    n=st.integers(1, 900),
+    thr=st.integers(0, 2**64 - 1),
+    k=st.integers(1, 8),
+)
+def test_device_sweep_equals_host_fold(fold_i, seed, lo, n, thr, k):
+    """Window-granular device partials combined across ragged windows
+    equal one host ``of_batch`` over the whole range, every discipline
+    (first-match early-stops on device; its accumulator is granularity-
+    independent by the probes construction). The shared (256, 2) shape
+    means one compile per variant per process."""
+    fold, variant, kk = _DEV_MAKERS[fold_i](thr, k)
+    hi = lo + n - 1
+    sweep = _sm.LaneSweep(variant, 256, 2, kk, "jnp")
+    dev = fold.initial()
+    g = lo
+    while g <= hi:
+        e = min(g + sweep.window - 1, hi)
+        dev = fold.combine(
+            dev, sweep.resolve(sweep.dispatch(seed, g, e, thr), g, e)
+        )
+        if fold.is_final(dev):
+            break
+        g = e + 1
+    assert dev == fold.of_batch(lo, _fold_vals(seed, lo, hi))
+
+
 @settings(max_examples=100)
 @given(
     seed=st.integers(0, 2**32 - 1),

@@ -643,3 +643,28 @@ def test_loadgen_workload_scenario_smoke(capsys):
     assert metrics["journal"]["records"] > 0
     for fold in ("fmin", "topk", "fmatch_hit", "fmatch_dry", "fsum"):
         assert metrics["answered_by_fold"].get(fold, 0) > 0, fold
+
+
+def test_loadgen_workload_scenario_dev_lanes(capsys):
+    """The SAME drill — worker kill, kill -9 coordinator crash, journal
+    restart — with the fleet forced onto the u32-pair device-lane
+    engine (ISSUE 17). The ledger's exact-value checks are computed
+    from the scalar objective, so zero ``answers_wrong`` here IS the
+    device/host equality claim under crash recovery; the gate
+    additionally requires the device engine demonstrably dispatched
+    (``dev_dispatches`` > 0 — a silent host fallback would make the
+    equality vacuous)."""
+    rc = loadgen.main([
+        "--scenario", "workload", "--duration", "1.5",
+        "--smoke", "--json", "--dev-lanes",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, f"dev-lanes workload gate failed: {out}"
+    metrics = _json.loads(out.splitlines()[0])
+    assert metrics["dev_lanes"] is True
+    assert metrics["dev_dispatches"] > 0
+    assert metrics["answered"] > 0
+    assert metrics["answers_wrong"] == 0
+    assert metrics["answers_duplicated"] == 0
+    assert metrics["answers_lost"] == 0
+    assert metrics["refused_fatal"] == 0

@@ -16,6 +16,7 @@ from tpuminter.kernels.sha256 import (
     pallas_search_target,
     pallas_sha256_batch,
 )
+from tpuminter.kernels.splitmix import pallas_splitmix_batch
 
 __all__ = [
     "pallas_sha256_batch",
@@ -24,4 +25,5 @@ __all__ = [
     "pallas_search_candidates_hdr",
     "pallas_search_candidates_hdr_batch",
     "pallas_min_toy",
+    "pallas_splitmix_batch",
 ]

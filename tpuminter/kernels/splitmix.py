@@ -1,0 +1,108 @@
+"""Pallas splitmix64: the hashcore objective on TPU lanes.
+
+The kernel mirror of :mod:`tpuminter.ops.splitmix` — same u32-pair
+word arithmetic (the pair primitives are imported, not re-derived, so
+the two engines cannot drift), laid out as ``(rows, 128)`` u32 tiles
+with a grid over row blocks, exactly like ``pallas_sha256_batch``.
+
+Unlike the ~6k-op SHA bodies, splitmix64 is ~40 vector ops, so
+``interpret=True`` on the CPU backend is *practical* here: tier-1 pins
+this kernel bit-for-bit against the scalar objective at small shapes
+(tests/test_hashcore_dev.py), and tests/test_kernels_tpu.py carries the
+pre-staged on-silicon section for compiled-Mosaic shapes when the
+tunnel returns.
+
+The "pallas" sweep engine (``ops.splitmix.sweep_program``) uses this
+kernel to materialize the window's value block, then runs the same
+in-program jnp fold scan over it — the fold logic has exactly one
+implementation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpuminter.ops.splitmix import splitmix64_pair
+
+__all__ = ["pallas_splitmix_batch", "LANES"]
+
+LANES = 128
+
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _plan(n: int) -> Tuple[int, int, int]:
+    """rows, block_rows, grid for an ``(n,)`` lane vector: the largest
+    block height ≤ 8 that divides the row count, so every dispatch
+    shape the sweep produces (width any multiple of 128) plans without
+    padding."""
+    if n % LANES:
+        raise ValueError(f"batch {n} must be a multiple of {LANES}")
+    rows = n // LANES
+    block_rows = next(b for b in (8, 4, 2, 1) if rows % b == 0)
+    return rows, block_rows, rows // block_rows
+
+
+def _splitmix_kernel(seed_ref, ih_ref, il_ref, vh_ref, vl_ref):
+    # seed words ride SMEM (scalar memory) — broadcast into the pair
+    # math against the VMEM index tiles
+    vh, vl = splitmix64_pair(
+        seed_ref[0], seed_ref[1], ih_ref[...], il_ref[...]
+    )
+    vh_ref[...] = vh
+    vl_ref[...] = vl
+
+
+@jax.jit
+def pallas_splitmix_batch(
+    seed_hi: jnp.ndarray,
+    seed_lo: jnp.ndarray,
+    idx_hi: jnp.ndarray,
+    idx_lo: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Objective values for a global-index batch: seed words (u32
+    scalars) + ``(N,) u32 × 2`` index words → ``(N,) u32 × 2`` value
+    words. Bit-identical to ``ops.splitmix.splitmix64_pair`` (and so to
+    the scalar ``workloads.hashcore.objective``)."""
+    n = idx_lo.shape[0]
+    rows, block_rows, grid = _plan(n)
+    seed = jnp.stack([seed_hi, seed_lo]).astype(jnp.uint32)
+    vh, vl = pl.pallas_call(
+        _splitmix_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((rows, LANES), jnp.uint32),
+            jax.ShapeDtypeStruct((rows, LANES), jnp.uint32),
+        ),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                (block_rows, LANES), lambda i: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (block_rows, LANES), lambda i: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=(
+            pl.BlockSpec(
+                (block_rows, LANES), lambda i: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (block_rows, LANES), lambda i: (i, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ),
+        interpret=_interpret(),
+    )(seed, idx_hi.reshape(rows, LANES), idx_lo.reshape(rows, LANES))
+    return vh.reshape(n), vl.reshape(n)

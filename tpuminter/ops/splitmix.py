@@ -1,0 +1,495 @@
+"""Device-lane splitmix64: the hashcore workload's compute engine on
+u32-pair lanes (ISSUE 17).
+
+The hashcore objective (``workloads.hashcore.objective``) is one
+splitmix64 draw per global index — three 64-bit multiplies and three
+xor-shifts.  The numpy host path runs it on native u64 lanes, but the
+jax workers cannot: the tier-1 control-plane drills (and the production
+CPU mesh) run ``JAX_PLATFORMS=cpu`` *without* ``jax_enable_x64``, so a
+u64 jnp array does not exist there.  This module implements the same
+arithmetic on **u32 pairs** — every u64 is a ``(hi, lo)`` word pair,
+64-bit multiplies decompose into 16-bit-limb partial products, shifts
+straddle the word boundary explicitly — which makes the objective
+expressible on every backend jax has, TPU included (VaultxGPU,
+arxiv 2606.14007, is the accelerator-side shape; HashCore itself,
+arxiv 1902.00112, is explicitly a general-purpose-processor PoW).
+
+Three layers:
+
+- **pair primitives** (:func:`add64`, :func:`mul64`, :func:`xorshr64`)
+  and :func:`splitmix64_pair` — pure jnp, usable inside Pallas kernel
+  bodies (``tpuminter.kernels.splitmix`` is the kernel mirror);
+- **the batched sweep** (:func:`sweep_program`) — one jitted program
+  per ``(variant, width, rows, k, engine)``, ``lru_cache``'d per the
+  PR 7 retrace rule: ``lax.scan`` over ``rows`` row-bases, ``width``
+  lanes per row, folding **in-program** for all four registered fold
+  disciplines (fmin / top-k / first-match / sum) so one device array
+  crosses the host boundary per dispatch;
+- **the dispatch seam** (:class:`LaneSweep`) — host-side span → device
+  arguments → decoded chunk-partial accumulator, bit-for-bit equal to
+  the host lanes' ``fold.of_batch``/``combine`` chain (the A/B
+  contract tests/test_hashcore_dev.py pins).
+
+Fold-equality notes (why bit-for-bit holds):
+
+- every fold's ``combine`` is associative with deterministic
+  index-tie-breaks, so window-granularity partials combine to the same
+  accumulator as the host's ``_BATCH``-granularity ones;
+- fmatch ``probes`` count full batches before the match plus the
+  offset inside the matching one — granularity-independent by
+  construction (``probes == index - lo + 1`` either way);
+- fsum accumulates exactly: per-row lane sums split into 16-bit
+  columns (``width <= 2^16`` keeps every column sum under 2^32), then
+  8×16-bit-limb carry propagation — integer-exact u128, same as the
+  host's Python-int ``sum``.
+
+Width is autotuned like the rolled plane (:func:`autotune_lane_width`,
+one-shot cached probe) but under its OWN cache keyed by
+``(backend, workload, engine, ...)`` so the rolled and hashcore probes
+never clobber each other.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "splitmix64_pair", "add64", "mul64", "xorshr64", "lane_objective",
+    "sweep_program", "LaneSweep", "lane_sweep", "autotune_lane_width",
+    "resolve_engine", "counters", "ROWS", "MAX_WIDTH",
+]
+
+_M32 = 0xFFFFFFFF
+_M64 = (1 << 64) - 1
+_UMAX = np.uint32(0xFFFFFFFF)
+
+#: splitmix64 constants as (hi, lo) u32 pairs
+_GOLDEN = (np.uint32(0x9E3779B9), np.uint32(0x7F4A7C15))
+_MIX1 = (np.uint32(0xBF58476D), np.uint32(0x1CE4E5B9))
+_MIX2 = (np.uint32(0x94D049BB), np.uint32(0x133111EB))
+
+#: rows per dispatch window (the lax.scan length): amortizes dispatch
+#: overhead across rows the way rolled.py's roll_batch amortizes rolls
+ROWS = 8
+
+#: fsum's 16-bit-column trick needs every per-row column sum to fit in
+#: u32: width lanes × (2^16 - 1) < 2^32 ⟺ width <= 2^16
+MAX_WIDTH = 1 << 16
+
+#: device dispatch evidence (bench / loadgen drills read the deltas;
+#: plain dict writes from the mining executor thread, GIL-atomic)
+counters: Dict[str, int] = {"dispatches": 0}
+
+
+# ---------------------------------------------------------------------------
+# u32-pair primitives (usable inside Pallas kernel bodies)
+# ---------------------------------------------------------------------------
+
+def add64(ah, al, bh, bl):
+    """``(ah‖al) + (bh‖bl) mod 2^64`` on u32 words: wrapping low add,
+    carry by unsigned compare."""
+    lo = al + bl
+    return ah + bh + (lo < al).astype(jnp.uint32), lo
+
+
+def _mulhilo32(a, b):
+    """Full 32×32→64 product as (hi, lo) u32 via 16-bit limbs — the
+    widest multiply XLA:CPU/Mosaic offer without an x64 dtype."""
+    al, ah = a & 0xFFFF, a >> 16
+    bl, bh = b & 0xFFFF, b >> 16
+    ll = al * bl
+    lh = al * bh
+    hl = ah * bl
+    # mid <= (2^16-1) + 2·(2^16-1) — never wraps u32
+    mid = (ll >> 16) + (lh & 0xFFFF) + (hl & 0xFFFF)
+    lo = (mid << 16) | (ll & 0xFFFF)
+    hi = ah * bh + (lh >> 16) + (hl >> 16) + (mid >> 16)
+    return hi, lo
+
+
+def mul64(ah, al, bh, bl):
+    """``(ah‖al) · (bh‖bl) mod 2^64``: one full 32×32 low-product plus
+    the two wrapping cross terms (the high×high term is ≥ 2^64 and
+    drops entirely)."""
+    hi, lo = _mulhilo32(al, bl)
+    return hi + al * bh + ah * bl, lo
+
+
+def xorshr64(h, l, s: int):
+    """``x ^ (x >> s)`` for ``0 < s < 32``: the high word shifts
+    internally, the low word receives the straddle bits."""
+    return h ^ (h >> s), l ^ ((l >> s) | (h << (32 - s)))
+
+
+def splitmix64_pair(seed_h, seed_l, idx_h, idx_l):
+    """The hashcore objective on u32-pair lanes: bit-for-bit
+    ``workloads.hashcore.objective(seed, index)`` (pinned in
+    tests/test_hashcore_dev.py across the u64 domain)."""
+    ih, il = add64(idx_h, idx_l, jnp.uint32(0), jnp.uint32(1))
+    zh, zl = mul64(ih, il, *_GOLDEN)
+    zh, zl = add64(zh, zl, seed_h, seed_l)
+    zh, zl = xorshr64(zh, zl, 30)
+    zh, zl = mul64(zh, zl, *_MIX1)
+    zh, zl = xorshr64(zh, zl, 27)
+    zh, zl = mul64(zh, zl, *_MIX2)
+    return xorshr64(zh, zl, 31)
+
+
+def lane_objective(seed: int, indices) -> list:
+    """Test/verification helper: objective values for an arbitrary
+    index iterable through the u32-pair lane math (eager jnp — not a
+    hot path; the sweep programs are)."""
+    idx = [int(i) & _M64 for i in indices]
+    ih = jnp.asarray(np.fromiter(
+        ((i >> 32) for i in idx), np.uint32, len(idx)))
+    il = jnp.asarray(np.fromiter(
+        ((i & _M32) for i in idx), np.uint32, len(idx)))
+    vh, vl = splitmix64_pair(
+        jnp.uint32(seed >> 32), jnp.uint32(seed & _M32), ih, il)
+    return [
+        (int(h) << 32) | int(l)
+        for h, l in zip(np.asarray(vh).tolist(), np.asarray(vl).tolist())
+    ]
+
+
+# ---------------------------------------------------------------------------
+# in-program fold bodies (one lax.scan row each)
+# ---------------------------------------------------------------------------
+
+def _lex_lt(a, b):
+    """Lexicographic ``a < b`` over equal-length u32 word tuples."""
+    lt = jnp.bool_(False)
+    eq = jnp.bool_(True)
+    for x, y in zip(a, b):
+        lt = lt | (eq & (x < y))
+        eq = eq & (x == y)
+    return lt
+
+
+def _masked_row(row, width: int):
+    """Unpack one row, sentinel-mask the invalid tail: masked lanes
+    become ``(value, index) = (2^64-1, 2^64-1)`` which lose every fold
+    (ties at value 2^64-1 still break to the real lane's lower index)."""
+    vh, vl, ih, il, valid = row
+    off = jnp.arange(width, dtype=jnp.uint32)
+    mask = off < valid
+    return (
+        jnp.where(mask, vh, _UMAX), jnp.where(mask, vl, _UMAX),
+        jnp.where(mask, ih, _UMAX), jnp.where(mask, il, _UMAX),
+        off, valid, mask,
+    )
+
+
+def _select_min_pair(sel, h, l):
+    """Min (hi, lo) pair over ``sel`` lanes (sentinel-max elsewhere):
+    staged min — minimize hi, then lo among the hi-minimal lanes."""
+    sh = jnp.where(sel, h, _UMAX)
+    mh = sh.min()
+    ml = jnp.where(sel & (sh == mh), l, _UMAX).min()
+    return mh, ml
+
+
+def _fmin_row(carry, row, width: int):
+    vh, vl, ih, il, _off, _valid, _mask = _masked_row(row, width)
+    mvh = vh.min()
+    mvl = jnp.where(vh == mvh, vl, _UMAX).min()
+    sel = (vh == mvh) & (vl == mvl)
+    mih, mil = _select_min_pair(sel, ih, il)
+    cand = (mvh, mvl, mih, mil)
+    take = _lex_lt(cand, carry)
+    return tuple(jnp.where(take, c, o) for c, o in zip(cand, carry)), None
+
+
+def _topk_row(carry, row, width: int, k: int):
+    vh, vl, ih, il, _off, _valid, _mask = _masked_row(row, width)
+    ops = tuple(
+        jnp.concatenate([lane, kept])
+        for lane, kept in zip((vh, vl, ih, il), carry)
+    )
+    svh, svl, sih, sil = jax.lax.sort(ops, num_keys=4)
+    return (svh[:k], svl[:k], sih[:k], sil[:k]), None
+
+
+def _fmatch_row(carry, row, width: int):
+    found, gih, gil, gvh, gvl, probes, th, tl = carry
+    vh, vl, ih, il, off, valid, mask = _masked_row(row, width)
+    # v <= thr  ⟺  not (thr < v); sentinel lanes only "match" a
+    # threshold of 2^64-1, where every real (lower-index) lane matches
+    # too, so they can never win the first-index fold
+    le = mask & ~_lex_lt((th, tl), (vh, vl))
+    first = jnp.where(le, off, _UMAX).min()
+    row_found = first != _UMAX
+    hit = off == first
+    rih, ril = _select_min_pair(hit, ih, il)
+    rvh, rvl = _select_min_pair(hit, vh, vl)
+    already = found > 0
+    # host probe accounting, row-granular: full valid counts for dry
+    # rows, offset+1 inside the matching one, nothing after it
+    probes = jnp.where(
+        already, probes,
+        probes + jnp.where(row_found, first + 1, valid),
+    )
+    take = (~already) & row_found
+    out = (
+        jnp.where(take, jnp.uint32(1), found),
+        jnp.where(take, rih, gih), jnp.where(take, ril, gil),
+        jnp.where(take, rvh, gvh), jnp.where(take, rvl, gvl),
+        probes, th, tl,
+    )
+    return out, None
+
+
+def _fsum_row(carry, row, width: int):
+    vh, vl, ih, il, valid = row
+    off = jnp.arange(width, dtype=jnp.uint32)
+    mask = off < valid
+    # 16-bit column sums: width <= 2^16 lanes × (2^16-1) < 2^32 each
+    s0 = jnp.sum(jnp.where(mask, vl & 0xFFFF, 0), dtype=jnp.uint32)
+    s1 = jnp.sum(jnp.where(mask, vl >> 16, 0), dtype=jnp.uint32)
+    s2 = jnp.sum(jnp.where(mask, vh & 0xFFFF, 0), dtype=jnp.uint32)
+    s3 = jnp.sum(jnp.where(mask, vh >> 16, 0), dtype=jnp.uint32)
+    adds = (
+        s0 & 0xFFFF,
+        (s0 >> 16) + (s1 & 0xFFFF),
+        (s1 >> 16) + (s2 & 0xFFFF),
+        (s2 >> 16) + (s3 & 0xFFFF),
+        s3 >> 16,
+    )
+    limbs = []
+    c = jnp.uint32(0)
+    for i in range(8):
+        t = carry[i] + c + (adds[i] if i < len(adds) else jnp.uint32(0))
+        limbs.append(t & 0xFFFF)
+        c = t >> 16
+    # the final carry is structurally zero: total < 2^96 << 2^128
+    return tuple(limbs), None
+
+
+# ---------------------------------------------------------------------------
+# the jitted sweep programs (lru_cache'd factories — PR 7 retrace rule)
+# ---------------------------------------------------------------------------
+
+def resolve_engine(engine: str = "auto") -> str:
+    """Mirror of ``rolled._resolve_engine``: jnp is the CPU-mesh engine,
+    the Pallas kernel the on-silicon one."""
+    if engine == "auto":
+        return "jnp" if jax.default_backend() == "cpu" else "pallas"
+    if engine not in ("jnp", "pallas"):
+        raise ValueError(f"unknown engine {engine!r}")
+    return engine
+
+
+def _row_lanes(seed_h, seed_l, bh, bl, width: int):
+    """In-program lane generation for one row: global index pairs from
+    a scalar (hi, lo) base plus the lane iota, then the objective."""
+    off = jnp.arange(width, dtype=jnp.uint32)
+    il = bl + off
+    ih = bh + (il < bl).astype(jnp.uint32)
+    vh, vl = splitmix64_pair(seed_h, seed_l, ih, il)
+    return vh, vl, ih, il
+
+
+@lru_cache(maxsize=None)
+def sweep_program(
+    variant: str, width: int, rows: int, k: int, engine: str
+):
+    """One compiled sweep per job-constant tuple. Dynamic arguments —
+    seed words, per-row base words, per-row valid counts, threshold
+    words — are traced, so ONE program serves every (seed, range,
+    threshold) at this shape; the output is ONE packed u32 array (one
+    host sync per dispatch):
+
+    - fmin  → ``(4,)``  best (value_hi, value_lo, index_hi, index_lo)
+    - topk  → ``(4, k)`` the k best columns, (value, index)-sorted
+    - fmatch→ ``(6,)``  (found, idx_hi, idx_lo, val_hi, val_lo, probes)
+    - fsum  → ``(8,)``  16-bit limbs of the exact u128 total, LE
+    """
+    if not 128 <= width <= MAX_WIDTH or width % 128:
+        raise ValueError(
+            f"width must be a multiple of 128 in [128, {MAX_WIDTH}]"
+        )
+    if variant not in ("fmin", "topk", "fmatch", "fsum"):
+        raise ValueError(f"unknown variant {variant!r}")
+
+    def run(seed_h, seed_l, bh, bl, valid, th, tl):
+        if engine == "pallas":
+            from tpuminter.kernels.splitmix import pallas_splitmix_batch
+
+            off = jnp.arange(width, dtype=jnp.uint32)
+            il = bl[:, None] + off[None, :]
+            ih = bh[:, None] + (il < bl[:, None]).astype(jnp.uint32)
+            vh, vl = pallas_splitmix_batch(
+                seed_h, seed_l, ih.reshape(-1), il.reshape(-1)
+            )
+            lanes = (vh.reshape(rows, width), vl.reshape(rows, width),
+                     ih, il)
+        else:
+            def gen(_, b):
+                return None, _row_lanes(seed_h, seed_l, b[0], b[1], width)
+
+            _, lanes = jax.lax.scan(gen, None, (bh, bl))
+        xs = lanes + (valid,)
+        if variant == "fmin":
+            init = (_UMAX,) * 4
+            out, _ = jax.lax.scan(
+                lambda c, r: _fmin_row(c, r, width), init, xs)
+            return jnp.stack(out)
+        if variant == "topk":
+            init = tuple(jnp.full((k,), _UMAX) for _ in range(4))
+            out, _ = jax.lax.scan(
+                lambda c, r: _topk_row(c, r, width, k), init, xs)
+            return jnp.stack(out)
+        if variant == "fmatch":
+            init = (jnp.uint32(0),) + (_UMAX,) * 4 + (
+                jnp.uint32(0), th, tl)
+            out, _ = jax.lax.scan(
+                lambda c, r: _fmatch_row(c, r, width), init, xs)
+            return jnp.stack(out[:6])
+        init = (jnp.uint32(0),) * 8
+        out, _ = jax.lax.scan(
+            lambda c, r: _fsum_row(c, r, width), init, xs)
+        return jnp.stack(out)
+
+    return jax.jit(run)
+
+
+# ---------------------------------------------------------------------------
+# dispatch seam: span in, chunk-partial accumulator out
+# ---------------------------------------------------------------------------
+
+class LaneSweep:
+    """Host face of one compiled sweep: :meth:`dispatch` is
+    non-blocking (jax async dispatch — the ``search.pipeline_spans``
+    contract), :meth:`resolve` is the single sync point and decodes the
+    packed device array into the fold discipline's accumulator shape."""
+
+    def __init__(self, variant: str, width: int, rows: int, k: int,
+                 engine: str):
+        self.variant = variant
+        self.width = width
+        self.rows = rows
+        self.k = k
+        self.engine = engine
+        self.window = rows * width
+        self._fn = sweep_program(variant, width, rows, k, engine)
+
+    def dispatch(self, seed: int, lo: int, hi: int, threshold: int = 0):
+        """Async sweep of global indices ``[lo, hi]`` (``hi - lo + 1 <=
+        window``); returns the device handle."""
+        total = hi - lo + 1
+        if not 1 <= total <= self.window:
+            raise ValueError("span must fit one dispatch window")
+        bh = np.empty(self.rows, np.uint32)
+        bl = np.empty(self.rows, np.uint32)
+        valid = np.empty(self.rows, np.uint32)
+        for r in range(self.rows):
+            base = (lo + r * self.width) & _M64
+            bh[r] = base >> 32
+            bl[r] = base & _M32
+            valid[r] = min(max(total - r * self.width, 0), self.width)
+        counters["dispatches"] += 1
+        return self._fn(
+            np.uint32(seed >> 32), np.uint32(seed & _M32),
+            bh, bl, valid,
+            np.uint32(threshold >> 32), np.uint32(threshold & _M32),
+        )
+
+    def resolve(self, handle, lo: int, hi: int):
+        """Block on ``handle`` and decode the window's chunk-partial
+        accumulator — the exact value ``fold.of_batch``+``combine``
+        produce on host lanes over the same span."""
+        out = np.asarray(handle).astype(np.uint64)
+        n = hi - lo + 1
+        if self.variant == "fmin":
+            return [int((out[0] << np.uint64(32)) | out[1]),
+                    int((out[2] << np.uint64(32)) | out[3])]
+        if self.variant == "topk":
+            count = min(self.k, n)
+            return [
+                [int((out[0, s] << np.uint64(32)) | out[1, s]),
+                 int((out[2, s] << np.uint64(32)) | out[3, s])]
+                for s in range(count)
+            ]
+        if self.variant == "fmatch":
+            probes = int(out[5])
+            if not int(out[0]):
+                return [None, None, probes]
+            return [int((out[1] << np.uint64(32)) | out[2]),
+                    int((out[3] << np.uint64(32)) | out[4]), probes]
+        total = sum(int(out[i]) << (16 * i) for i in range(8))
+        return [total, n]
+
+
+@lru_cache(maxsize=None)
+def lane_sweep(
+    variant: str,
+    *,
+    k: int = 1,
+    engine: str = "auto",
+    width: Optional[int] = None,
+    rows: int = ROWS,
+) -> LaneSweep:
+    """The factory the hashcore workload uses: resolves the engine and
+    the (autotuned unless pinned) width once, then hands back the
+    process-cached :class:`LaneSweep` for this job-constant tuple."""
+    engine = resolve_engine(engine)
+    if width is None:
+        width = autotune_lane_width(engine, rows=rows)
+    return LaneSweep(variant, int(width), rows,
+                     k if variant == "topk" else 1, engine)
+
+
+# ---------------------------------------------------------------------------
+# width autotune: one-shot cached probe, hashcore's OWN cache
+# ---------------------------------------------------------------------------
+
+#: (backend, workload, engine, candidates, rows) -> winning width.
+#: Deliberately a separate dict from rolled._autotune_cache — the key
+#: spaces overlap in spirit (both are per-backend width probes) and a
+#: shared cache would let one workload's winner shadow the other's.
+_autotune_cache: Dict[Tuple, int] = {}
+
+
+def autotune_lane_width(
+    engine: str = "jnp",
+    candidates: Tuple[int, ...] = (2048, 4096, 8192, 16384),
+    *,
+    rows: int = ROWS,
+    reps: int = 3,
+) -> int:
+    """``rolled.autotune_width``'s shape, retargeted: time the fmin
+    sweep program over dummy data at each candidate width, keep the
+    best per-index rate, cache for the process lifetime. The probe
+    compiles each candidate once — the winner's program is therefore
+    already warm when the first real chunk dispatches."""
+    from tpuminter.search import timed_call
+
+    engine = resolve_engine(engine)
+    key = (jax.default_backend(), "hashcore", engine,
+           tuple(candidates), rows)
+    hit = _autotune_cache.get(key)
+    if hit is not None:
+        return hit
+    best_width, best_rate = candidates[0], -1.0
+    for width in candidates:
+        sweep = LaneSweep("fmin", width, rows, 1, engine)
+        np.asarray(sweep.dispatch(0xA0701E, 0, sweep.window - 1))
+        dt = min(
+            timed_call(
+                lambda w=sweep: np.asarray(
+                    w.dispatch(0xA0701E, 0, w.window - 1)
+                ),
+                (),
+            )
+            for _ in range(max(1, reps))
+        )
+        rate = sweep.window / dt
+        if rate > best_rate:
+            best_width, best_rate = width, rate
+    _autotune_cache[key] = best_width
+    return best_width

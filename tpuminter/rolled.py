@@ -44,7 +44,6 @@ min-fold machinery gets exercised at toy difficulty.
 from __future__ import annotations
 
 import struct
-import time
 from functools import lru_cache, partial
 from typing import Callable, Dict, Iterator, NamedTuple, Optional, Tuple, Union
 
@@ -55,7 +54,9 @@ import numpy as np
 from tpuminter import chain
 from tpuminter.ops import sha256 as ops
 from tpuminter.protocol import MIN_UNTRACKED, Request, Result
-from tpuminter.search import CandidateSearch, pack_handle, pipeline_spans, resolve_handle
+from tpuminter.search import (
+    CandidateSearch, pack_handle, pipeline_spans, resolve_handle, timed_call,
+)
 
 __all__ = [
     "plan_tiles",
@@ -394,7 +395,7 @@ def autotune_width(
                 sched_share)
         _jnp_batched_candidate_sweep(*args).block_until_ready()  # compile
         dt = min(
-            _timed_call(_jnp_batched_candidate_sweep, args)
+            timed_call(_jnp_batched_candidate_sweep, args)
             for _ in range(max(1, reps))
         )
         rate = rows * width / dt
@@ -402,12 +403,6 @@ def autotune_width(
             best_width, best_rate = width, rate
     _autotune_cache[key] = best_width
     return best_width
-
-
-def _timed_call(fn, args) -> float:
-    t0 = time.perf_counter()
-    fn(*args).block_until_ready()
-    return time.perf_counter() - t0
 
 
 # ---------------------------------------------------------------------------

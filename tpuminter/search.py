@@ -34,7 +34,9 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Iterable, Iterator, List, Optional, Tuple
 
-__all__ = ["CandidateSearch", "SearchOutcome", "pipeline_spans"]
+__all__ = [
+    "CandidateSearch", "SearchOutcome", "pipeline_spans", "timed_call",
+]
 
 #: sweep(base, n) -> opaque handle (asynchronous dispatch)
 SweepFn = Callable[[int, int], object]
@@ -62,6 +64,22 @@ def resolve_handle(handle) -> Tuple[int, int]:
 
     arr = np.asarray(handle)
     return int(arr[0]), int(arr[1])
+
+
+def timed_call(fn, args) -> float:
+    """Wall-clock ONE device call, dispatch through completion — the
+    shared probe primitive behind the one-shot width autotunes
+    (``rolled.autotune_width``, ``ops.splitmix.autotune_lane_width``).
+    Blocks via ``block_until_ready`` when the return value offers it;
+    callers that sync some other way (``np.asarray`` inside ``fn``)
+    just return a plain value."""
+    import time
+
+    t0 = time.perf_counter()
+    out = fn(*args)
+    if hasattr(out, "block_until_ready"):
+        out.block_until_ready()
+    return time.perf_counter() - t0
 
 
 def pipeline_spans(

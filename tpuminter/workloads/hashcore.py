@@ -37,17 +37,18 @@ verification executor (the scrypt seam), never on the serve loop.
 
 from __future__ import annotations
 
+import os
 import struct
 import zlib
 from dataclasses import dataclass
-from typing import List
+from typing import Any, Dict, List, Optional
 
 from tpuminter.workloads import Workload, register
 from tpuminter.workloads import folds
 
 __all__ = [
     "HashCore", "HashParams", "objective", "pack_params", "VARIANTS",
-    "HASHCORE_WID",
+    "HASHCORE_WID", "set_dev_lanes", "dev_lanes_config",
 ]
 
 #: Compact workload id on binary WorkResult frames. One process-wide
@@ -132,12 +133,99 @@ def parse_params(data: bytes) -> HashParams:
 # engine seam: batch evaluation, resolved per-Setup by the worker
 # ---------------------------------------------------------------------------
 
+#: Device-lane knob (ISSUE 17). ``mode``: "auto" routes jax-family
+#: backends (jax/tpu/pod) through the u32-pair device engine and keeps
+#: cpu workers on host lanes; "on"/"off" force it either way — "off" IS
+#: the bit-for-bit A/B baseline (the numpy path below is untouched).
+#: ``width``/``rows``/``engine`` pass through to
+#: ``ops.splitmix.lane_sweep`` (width None = the autotune probe).
+_dev_cfg: Dict[str, Any] = {
+    "mode": os.environ.get("TPUMINTER_HC_DEV_LANES", "auto"),
+    "width": None,
+    "rows": None,
+    "engine": "auto",
+}
+
+_UNSET = object()
+
+
+def set_dev_lanes(
+    mode: Optional[str] = None,
+    *,
+    width: Any = _UNSET,
+    rows: Any = _UNSET,
+    engine: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Configure the device-lane engine; returns the PRIOR config so
+    drills can snapshot/restore. Unspecified fields keep their value."""
+    prior = dict(_dev_cfg)
+    if mode is not None:
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(f"dev_lanes mode {mode!r}")
+        _dev_cfg["mode"] = mode
+    if width is not _UNSET:
+        _dev_cfg["width"] = width
+    if rows is not _UNSET:
+        _dev_cfg["rows"] = rows
+    if engine is not None:
+        _dev_cfg["engine"] = engine
+    return prior
+
+
+def dev_lanes_config() -> Dict[str, Any]:
+    return dict(_dev_cfg)
+
+
+def _use_dev_lanes(engine: str) -> bool:
+    mode = _dev_cfg["mode"]
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    return engine in ("jax", "tpu", "pod")
+
+
+def _dev_sweep(p: "HashParams", total: int):
+    """Resolve the process-cached LaneSweep for this job's constants, or
+    None when device-lane setup fails (no jax on this host, bad pinned
+    width ...) — the caller then falls back to host lanes. Only SETUP
+    errors are swallowed; an error after dispatching propagates like any
+    compute failure.
+
+    An AUTOTUNED width is clamped so one window does not dwarf the
+    chunk: the probe optimizes lanes/s at saturation, but a chunk
+    smaller than ``rows × width`` still pays for every masked lane
+    (bench_workload_dev's 4096-index arm measured 16× waste before the
+    clamp). Chunk sizes are uniform per deployment, so the clamp costs
+    one compile, not one per job. A PINNED width is honored verbatim —
+    tests pin shapes for deterministic compile reuse."""
+    try:
+        from tpuminter.ops import splitmix
+
+        rows = _dev_cfg["rows"] or splitmix.ROWS
+        width = _dev_cfg["width"]
+        if width is None:
+            width = splitmix.autotune_lane_width(
+                _dev_cfg["engine"], rows=rows
+            )
+            per_row = -(-total // rows)
+            need = max(128, -(-per_row // 128) * 128)
+            width = min(width, need)
+        return splitmix.lane_sweep(
+            p.variant, k=p.k, engine=_dev_cfg["engine"],
+            width=width, rows=rows,
+        )
+    except Exception:
+        return None
+
+
 def _values_vectorized(seed: int, lo: int, hi: int) -> List[int]:
     """One batch on u64 lanes. numpy's wrapping uint64 arithmetic IS
-    mod-2^64, so this is bit-exact with :func:`objective`; a jnp/Pallas
-    port is the same expression on device lanes (the x64 flag permitting
-    — the control-plane drills run JAX_PLATFORMS=cpu without it, which
-    is why the host-lane path is the shipped accelerator engine)."""
+    mod-2^64, so this is bit-exact with :func:`objective`; the u32-pair
+    device-lane port of the same expression is ``tpuminter.ops.splitmix``
+    (hi/lo word arithmetic, so it needs no x64 flag — the control-plane
+    drills run JAX_PLATFORMS=cpu without it, which kept THIS host-lane
+    path as the shipped engine until ISSUE 17)."""
     import numpy as np
 
     idx = np.arange(lo, hi + 1, dtype=np.uint64)
@@ -178,9 +266,16 @@ class HashCore(Workload):
         """Generic batch scan: every variant is ``of_batch`` +
         ``combine``, and first-match stops as soon as ``is_final``
         fires — the worker-side mirror of the coordinator's
-        early-cancel."""
+        early-cancel. When the device-lane knob routes this backend
+        (``set_dev_lanes``), the scan runs as pipelined u32-pair sweep
+        windows instead (:meth:`_compute_dev`) — same accumulator,
+        same ``searched``, bit for bit."""
         p = parse_params(request.data)
         lo, hi = request.lower, request.upper
+        if _use_dev_lanes(engine):
+            sweep = _dev_sweep(p, hi - lo + 1)
+            if sweep is not None:
+                return (yield from self._compute_dev(p, fold, lo, hi, sweep))
         acc, searched = fold.initial(), 0
         index = lo
         while index <= hi:
@@ -191,6 +286,42 @@ class HashCore(Workload):
             if fold.is_final(acc):
                 break
             index = last + 1
+            yield None
+        return searched, acc
+
+    def _compute_dev(self, p, fold: folds.Fold, lo: int, hi: int, sweep):
+        """Device-lane scan: dispatch windows of ``rows × width``
+        indices depth-2 through ``search.pipeline_spans`` (the dispatch
+        latency of window *n+1* overlaps the fold of window *n*),
+        resolve ONE packed array per window, and combine the decoded
+        chunk-partials — associative folds with deterministic
+        tie-breaks, so window granularity produces the same accumulator
+        as the host path's ``_BATCH`` granularity.
+
+        The one granularity-dependent output is first-match's early-stop
+        ``searched``: the host loop counts whole ``_BATCH`` batches
+        through the matching one, so the device path reproduces that
+        count *from the match index* rather than from its own window
+        size. Early return abandons in-flight handles un-resolved —
+        the documented ``pipeline_spans`` contract."""
+        from tpuminter.search import pipeline_spans
+
+        spans = (
+            (g, min(g + sweep.window - 1, hi))
+            for g in range(lo, hi + 1, sweep.window)
+        )
+        acc, searched = fold.initial(), 0
+        for (g, e), handle in pipeline_spans(
+            spans, lambda s: sweep.dispatch(p.seed, s[0], s[1], p.threshold)
+        ):
+            acc = fold.combine(acc, sweep.resolve(handle, g, e))
+            if fold.is_final(acc):
+                match = acc[0]
+                searched = min(
+                    ((match - lo) // _BATCH + 1) * _BATCH, hi - lo + 1
+                )
+                return searched, acc
+            searched += e - g + 1
             yield None
         return searched, acc
 
