@@ -753,6 +753,235 @@ def bench_replication(duration: float = 4.0, pairs: int = 3) -> dict:
     }
 
 
+def bench_federation(smoke: bool = False) -> dict:
+    """Federation fan-in + chain replication figures (ISSUE 18),
+    CPU-only like the other control-plane sections.
+
+    - ``fed_parent_msgs_per_segment_fleetN`` — control messages the
+      parent coordinator absorbs (beacons + results accepted) per
+      settled rolled segment, with N miners behind ONE aggregator.
+      The aggregator merges its fleet's beacon firehose into one
+      bounded-cadence stream per lease, so this figure must stay flat
+      as the fleet grows: ``fed_fanin_msgs_ratio`` (largest fleet over
+      fleet 1) is the acceptance gate, ≤ 2×. ``fed_inner_*`` records
+      the UN-merged inner-tier rate for contrast — the flattening is
+      the gap between the two.
+    - ``fed_chain_one_primary_stream`` — with a 2-deep standby chain
+      (primary → s1 → s2) the primary's shipped bytes equal its WAL
+      size exactly: it paid for ONE stream, the re-ship to s2 came out
+      of s1's budget.
+    - ``fed_chain_overhead_pct`` — results/s lost END-TO-END to chain
+      replication on the two-process topology the acceptance names:
+      the primary (coordinator + journal + one shipping lane) in this
+      process, a 2-hop standby chain hosted by a separate ``loadgen
+      --scenario chain-host`` process. Paired-median protocol of
+      ``bench_replication`` (alternating replication-off / chained
+      runs at fleet 8); the ≤ 5 pp goal assumes the topology's point —
+      the replica process on its own core. This image pins ONE core,
+      so the replica still steals primary cycles here and the figure
+      carries the same ±15 pp ambient swing the colocated
+      ``replication_overhead_pct`` history shows (BENCH_r10–r14:
+      6.4, 9.5, 18.4, 6.8, −13.2); the structural half of the claim —
+      exactly one primary stream however deep the chain — is the
+      deterministic ``fed_chain_one_primary_stream`` gate.
+    - ``fed_chain_sync_ms_*`` — wall time from first append until hop
+      1 holds a 300-record WAL, single standby vs 2-deep chain (the
+      raw latency view of the same seam, min of 3).
+    """
+    import asyncio
+    import os as _os
+    import shutil
+    import statistics as _statistics
+    import subprocess
+    import sys
+    import tempfile
+
+    import numpy as np
+
+    from tpuminter.client import submit
+    from tpuminter.coordinator import Coordinator
+    from tpuminter.federation.aggregator import Aggregator
+    from tpuminter.journal import Journal
+    from tpuminter.lsp import Params
+    from tpuminter.protocol import PowMode, Request, request_to_obj
+    from tpuminter.replication import ReplicationPrimary, ReplicationStandby
+    from tpuminter.worker import CpuMiner, run_miner
+
+    params = Params(
+        epoch_limit=5, epoch_millis=50, window_size=32,
+        max_backoff_interval=2, max_unacked_messages=32,
+    )
+    nb = 10
+    ens = 8 if smoke else 16
+    rng = np.random.RandomState(18)
+    prefix, suffix = rng.bytes(41), rng.bytes(60)
+    branch = (rng.bytes(32), rng.bytes(32))
+    hdr80 = chain.GENESIS_HEADER.pack()
+    req = Request(
+        job_id=1, mode=PowMode.TARGET, lower=0, upper=(ens << nb) - 1,
+        header=hdr80, target=1,  # unbeatable: every segment settles
+        coinbase_prefix=prefix, coinbase_suffix=suffix,
+        extranonce_size=4, branch=branch, nonce_bits=nb,
+    )
+    out = {}
+
+    async def fanin(n):
+        parent = await Coordinator.create(params=params, roll_budget=4)
+        pserve = asyncio.ensure_future(parent.serve())
+        agg = await Aggregator.create(
+            "bench", [("127.0.0.1", parent.port)], params=params,
+            beacon_interval=0.05, roll_budget=2,
+        )
+        aserve = asyncio.ensure_future(agg.serve())
+        miners = [
+            asyncio.ensure_future(run_miner(
+                "127.0.0.1", agg.port, CpuMiner(batch=64),
+                params=params, roll=True, beacon_interval=1e-6,
+            ))
+            for _ in range(n)
+        ]
+        try:
+            res = await asyncio.wait_for(
+                submit("127.0.0.1", parent.port, req, params=params),
+                60.0,
+            )
+            assert not res.found
+            segments = parent.stats["hashes"] >> nb
+            up = (parent.stats["beacons_accepted"]
+                  + parent.stats["results_accepted"])
+            inner = (agg.inner.stats["beacons_accepted"]
+                     + agg.inner.stats["results_accepted"])
+            return up / max(segments, 1), inner / max(segments, 1)
+        finally:
+            for t in miners + [aserve, pserve]:
+                t.cancel()
+            await asyncio.gather(*miners, aserve, pserve,
+                                 return_exceptions=True)
+            await agg.close()
+            await parent.close()
+
+    points = (1, 4) if smoke else (1, 8)
+    for n in points:
+        up, inner = asyncio.run(fanin(n))
+        out[f"fed_parent_msgs_per_segment_fleet{n}"] = round(up, 3)
+        out[f"fed_inner_msgs_per_segment_fleet{n}"] = round(inner, 3)
+    out["fed_fanin_msgs_ratio"] = round(
+        out[f"fed_parent_msgs_per_segment_fleet{points[-1]}"]
+        / max(out[f"fed_parent_msgs_per_segment_fleet{points[0]}"], 1e-9),
+        3,
+    )
+
+    loadgen = _import_loadgen()
+    pairs, lg_duration = (1, 1.5) if smoke else (3, 4.0)
+
+    def chained_run():
+        # a FRESH replica process per run: each primary boots with a
+        # fresh journal epoch, and a standby that already followed a
+        # higher epoch would fence the newcomer out (by design)
+        chain_dir = tempfile.mkdtemp()
+        port_file = _os.path.join(chain_dir, "port")
+        host = subprocess.Popen(
+            [sys.executable,
+             _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                           "scripts", "loadgen.py"),
+             "--scenario", "chain-host", "--hops", "2",
+             "--wal-dir", chain_dir, "--port-file", port_file],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        tmp = tempfile.mktemp(suffix=".wal")
+        try:
+            deadline = time.monotonic() + 30.0
+            while not _os.path.exists(port_file):
+                if host.poll() is not None or time.monotonic() > deadline:
+                    raise RuntimeError("chain-host never came up")
+                time.sleep(0.05)
+            chain_port = int(open(port_file).read())
+            return asyncio.run(loadgen.run_load(
+                8, 4, lg_duration, journal_path=tmp,
+                replicate_to_addr=[("127.0.0.1", chain_port)],
+            ))["results_per_s"]
+        finally:
+            host.terminate()
+            host.wait(timeout=10)
+            shutil.rmtree(chain_dir, ignore_errors=True)
+            if _os.path.exists(tmp):
+                _os.unlink(tmp)
+
+    def off_run():
+        tmp = tempfile.mktemp(suffix=".wal")
+        try:
+            return asyncio.run(loadgen.run_load(
+                8, 4, lg_duration, journal_path=tmp,
+            ))["results_per_s"]
+        finally:
+            if _os.path.exists(tmp):
+                _os.unlink(tmp)
+
+    ratios = []
+    for _ in range(pairs):
+        off = off_run()
+        ratios.append(chained_run() / max(off, 1e-9))
+    out["fed_chain_overhead_pct"] = round(
+        100.0 * (1.0 - _statistics.median(ratios)), 2
+    )
+
+    n_records = 300
+
+    async def chain_arm(depth):
+        d = tempfile.mkdtemp()
+        journal, _ = Journal.open(_os.path.join(d, "p.wal"))
+        hops = []
+        chain_to = None
+        for hop in range(depth, 0, -1):  # tail hop first
+            s = await ReplicationStandby.create(
+                _os.path.join(d, "s%d.wal" % hop), params=params,
+                chain_to=chain_to,
+            )
+            hops.insert(0, (s, asyncio.ensure_future(s.run())))
+            chain_to = [("127.0.0.1", s.port)]
+        s1, tail = hops[0][0], hops[-1][0]
+        prim = ReplicationPrimary(
+            journal, "127.0.0.1", s1.port, params=params,
+        )
+        prim.start()
+        try:
+            t0 = time.perf_counter()
+            for jid in range(1, n_records + 1):
+                journal.append("job", {"id": jid, "req": request_to_obj(
+                    Request(job_id=jid, mode=PowMode.MIN, lower=0,
+                            upper=4095, data=b"fed-%d" % jid)
+                )})
+            await journal.flush()
+            while s1.size < journal.size:
+                await asyncio.sleep(0.001)
+            elapsed = time.perf_counter() - t0
+            while tail.size < journal.size:
+                await asyncio.sleep(0.001)
+            one_stream = prim.stats["bytes_shipped"] == journal.size
+            return elapsed, one_stream
+        finally:
+            await prim.stop()
+            for s, task in hops:
+                task.cancel()
+            await asyncio.gather(*(t for _, t in hops),
+                                 return_exceptions=True)
+            for s, _ in hops:
+                await s.close()
+            await journal.aclose()
+
+    singles, chained, one_stream = [], [], True
+    for _ in range(3):
+        t1, _ok = asyncio.run(chain_arm(1))
+        t2, ok2 = asyncio.run(chain_arm(2))
+        singles.append(t1)
+        chained.append(t2)
+        one_stream = one_stream and ok2
+    out["fed_chain_one_primary_stream"] = one_stream
+    out["fed_chain_sync_ms_single"] = round(min(singles) * 1e3, 1)
+    out["fed_chain_sync_ms_depth2"] = round(min(chained) * 1e3, 1)
+    return out
+
+
 def bench_chaos(duration: float = 1.2, seed: int = 0,
                 smoke: bool = False) -> dict:
     """Chaos-matrix resilience figures (ISSUE 12), CPU-only like the
@@ -1426,6 +1655,7 @@ def main() -> None:
         extra.update(bench_multiloop(fleet=8, duration=1.5, pairs=1))
         extra.update(bench_recovery(duration=1.5, pairs=1))
         extra.update(bench_replication(duration=1.5, pairs=1))
+        extra.update(bench_federation(smoke=True))
         extra.update(bench_chaos(duration=1.0, smoke=True))
         extra.update(bench_admission(smoke=True))
         extra.update(bench_rolled(pairs=1, nb_points=(8,)))
@@ -1447,6 +1677,7 @@ def main() -> None:
         extra.update(bench_multiloop())
         extra.update(bench_recovery())
         extra.update(bench_replication())
+        extra.update(bench_federation())
         extra.update(bench_chaos())
         extra.update(bench_admission())
         extra.update(bench_rolled())
@@ -1483,6 +1714,7 @@ def main() -> None:
         extra.update(bench_multiloop())
         extra.update(bench_recovery())
         extra.update(bench_replication())
+        extra.update(bench_federation())
         extra.update(bench_chaos())
         extra.update(bench_admission())
         extra.update(bench_rolled())

@@ -419,6 +419,8 @@ async def run_load(
     journal_tick_flush: bool = True,
     standby: bool = False,
     standby_sink: bool = False,
+    chain: int = 0,
+    replicate_to_addr=None,
     replica_ack: bool = False,
     miner_delay: float = 0.0,
     loops: int = 1,
@@ -445,16 +447,35 @@ async def run_load(
     sweep)."""
     stby = None
     replicate_to = None
-    if standby:
+    chain_hops: list = []
+    if replicate_to_addr is not None:
+        # ship to an EXTERNAL standby (e.g. a --scenario chain-host
+        # process): the two-process topology the chain-replication
+        # bench measures — none of the replica work shares this loop
+        if journal_path is None:
+            raise ValueError("replicate_to_addr requires a journal_path")
+        replicate_to = list(replicate_to_addr)
+    elif standby:
         if journal_path is None:
             raise ValueError("standby=True requires a journal_path")
         from tpuminter.replication import ReplicationStandby
 
+        # chain replication (ISSUE 18): `chain` extra hops BELOW the
+        # hot standby, built tail-first so each hop knows where to
+        # re-ship — the primary still pays for exactly one stream
+        chain_to = None
+        for hop in range(chain, 0, -1):
+            tail = await ReplicationStandby.create(
+                journal_path + ".chain%d" % hop, params=params,
+                apply_shadow=not standby_sink, chain_to=chain_to,
+            )
+            chain_hops.insert(0, (tail, asyncio.ensure_future(tail.run())))
+            chain_to = [("127.0.0.1", tail.port)]
         stby = await ReplicationStandby.create(
             journal_path + ".standby", params=params,
             # sink mode: persist+ack but no live shadow replay — the
             # per-stage decomposition seam (PERF.md §Round 10)
-            apply_shadow=not standby_sink,
+            apply_shadow=not standby_sink, chain_to=chain_to,
         )
         stby_task = asyncio.ensure_future(stby.run())
         replicate_to = [("127.0.0.1", stby.port)]
@@ -629,6 +650,15 @@ async def run_load(
                         (coord._journal.size if coord._journal else 0)
                         - stby.size
                     ),
+                    **(
+                        {
+                            "chain_tail_bytes": chain_hops[-1][0].size,
+                            "chain_tail_lag_bytes": (
+                                stby.size - chain_hops[-1][0].size
+                            ),
+                        }
+                        if chain_hops else {}
+                    ),
                 }
                 if stby is not None else {}
             ),
@@ -646,6 +676,10 @@ async def run_load(
             stby_task.cancel()
             await asyncio.gather(stby_task, return_exceptions=True)
             await stby.close()
+        for hop, hop_task in chain_hops:
+            hop_task.cancel()
+            await asyncio.gather(hop_task, return_exceptions=True)
+            await hop.close()
 
 
 def smoke_check(metrics: dict, params: Params = FAST) -> list:
@@ -1359,6 +1393,51 @@ def crash_check(metrics: dict) -> list:
 
 
 # ---------------------------------------------------------------------------
+# chain-host scenario (ISSUE 18): a replica process hosting a standby chain
+# ---------------------------------------------------------------------------
+
+async def run_chain_host(
+    hops: int,
+    wal_dir: str,
+    port_file: str,
+    params: Params = FAST,
+) -> None:
+    """Host ``hops`` chained standbys in THIS process and serve until
+    killed. The entry hop's port is written to ``port_file`` once the
+    whole chain is listening; a primary in another process points
+    ``replicate_to`` at it — the two-process topology the chain-
+    replication bench measures, where none of the replica-side work
+    (persist, shadow replay, re-ship) shares the primary's core."""
+    from tpuminter.replication import ReplicationStandby
+
+    chain_to = None
+    standbys = []
+    for hop in range(hops, 0, -1):  # tail hop first
+        s = await ReplicationStandby.create(
+            os.path.join(wal_dir, "hop%d.wal" % hop), params=params,
+            chain_to=chain_to,
+        )
+        standbys.insert(0, (s, asyncio.ensure_future(s.run())))
+        chain_to = [("127.0.0.1", s.port)]
+    def publish_port(port: int) -> None:
+        tmp = port_file + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.write(str(port))
+        os.replace(tmp, port_file)  # atomic: never a torn port
+
+    await asyncio.get_running_loop().run_in_executor(
+        None, publish_port, standbys[0][0].port
+    )
+    try:
+        await asyncio.Event().wait()
+    finally:
+        for s, task in standbys:
+            task.cancel()
+            await asyncio.gather(task, return_exceptions=True)
+            await s.close()
+
+
+# ---------------------------------------------------------------------------
 # failover scenario (ISSUE 5): kill the primary machine, promote the standby
 # ---------------------------------------------------------------------------
 
@@ -2023,10 +2102,11 @@ def workload_check(metrics: dict) -> list:
 CHAOS_CELLS = (
     "netsplit", "asym_loss", "delay_reorder",
     "fsync_stall", "enospc", "byzantine",
-    "fleet_partition", "flapping_link",
+    "fleet_partition", "flapping_link", "slow_loris",
 )
-#: the tier-1 smoke subset: one partition cell + one byzantine cell
-CHAOS_SMOKE_CELLS = ("netsplit", "byzantine")
+#: the tier-1 smoke subset: one partition cell + one byzantine cell +
+#: the slow-loris reaping cell (ISSUE 18 satellite)
+CHAOS_SMOKE_CELLS = ("netsplit", "byzantine", "slow_loris")
 
 
 async def _byzantine_session(
@@ -2141,6 +2221,62 @@ async def _byzantine_miner(
         await asyncio.sleep(next(delays))
 
 
+async def _slow_loris_actor(
+    ports, params: Params, seed: int, *, drops: dict,
+    behavior: str = "drip", binary: bool = True,
+) -> None:
+    """A slow-loris actor (ISSUE 18 satellite: handshake/read
+    deadlines): instead of starving the accept queue it starves the
+    coordinator's REASSEMBLY buffer —
+
+    - ``mute``: completes the transport handshake, then never speaks a
+      single app message; only the server-side first-message deadline
+      can reap it (liveness pings flow, so silence detectors never
+      fire).
+    - ``drip``: Joins honestly — so it LOOKS like a miner and soaks up
+      Assigns — then starts a message it never finishes, feeding one
+      more-fragments frame per epoch. Every epoch makes one byte of
+      progress, which defeats any stall-reset deadline by design; only
+      the TOTAL-time read deadline bounds it.
+
+    Counts each server-side reap in ``drops["n"]`` and redials (repeat
+    offenders come back, same loop shape as ``_byzantine_miner``)."""
+    import random as _random
+
+    from tpuminter.lsp.connection import _MORE
+    from tpuminter.replication import dial_patience
+
+    if isinstance(ports, int):
+        ports = [ports]
+    rng = _random.Random(seed)
+    delays = jittered_backoff(0.05, 1.0, rng)
+    ce = dial_patience(ports)
+    attempt = 0
+    while True:
+        port = ports[attempt % len(ports)]
+        attempt += 1
+        try:
+            w = await LspClient.connect(
+                "127.0.0.1", port, params, connect_epochs=ce
+            )
+            try:
+                if behavior == "drip":
+                    w.write(encode_msg(Join(
+                        backend="loris", lanes=1,
+                        codec="bin" if binary else "json",
+                    )))
+                while not w.is_lost:
+                    if behavior == "drip":
+                        w._conn._send_data(_MORE + b"z")
+                    await asyncio.sleep(params.epoch_seconds)
+                drops["n"] += 1
+            finally:
+                await w.close(drain_timeout=0.0)
+        except (LspConnectError, LspConnectionLost, ConnectionError):
+            pass
+        await asyncio.sleep(next(delays))
+
+
 async def _chaos_fleet_cell(
     name: str,
     seed: int,
@@ -2179,11 +2315,24 @@ async def _chaos_fleet_cell(
       the loss horizon (dark windows of horizon/4): retransmission must
       ride it out with zero loss declarations and zero evictions
       (ISSUE 13)
+    - ``slow_loris`` — drip-feeding actors that Join then never finish
+      a message (one more-fragments frame per epoch: byte progress
+      every epoch, so liveness never trips) plus mute actors that
+      handshake and never speak; the read/first-message deadlines must
+      reap both while the honest ledger settles exactly once (ISSUE 18)
     """
+    import dataclasses
     import shutil
 
     from tpuminter.chaos import DiskFaultPlan, FaultPlan
 
+    if name == "slow_loris":
+        # arm the deadlines the cell exercises: generous next to honest
+        # traffic (a full app message lands within an epoch on
+        # loopback) yet well inside the fault window
+        params = dataclasses.replace(
+            params, read_deadline_epochs=params.epoch_limit + 2
+        )
     tmpdir = tempfile.mkdtemp(prefix="tpuminter-chaos-")
     journal_path = os.path.join(tmpdir, "chaos.wal")
     coord = await make_coordinator(
@@ -2218,7 +2367,8 @@ async def _chaos_fleet_cell(
         for i in range(honest)
     ]
     lost_events = {"n": 0}
-    if name == "flapping_link":
+    loris_drops = {"n": 0}
+    if name in ("flapping_link", "slow_loris"):
         _hook_lost_events(coord, lost_events)
     clients = [
         asyncio.ensure_future(_durable_client_loop(
@@ -2260,6 +2410,21 @@ async def _chaos_fleet_cell(
                 ))
                 for i, b in enumerate(byz_behaviors)
             ]
+        elif name == "slow_loris":
+            byz = [
+                asyncio.ensure_future(_slow_loris_actor(
+                    port, params, seed * 100 + 50 + i, drops=loris_drops,
+                    behavior=b, binary=binary,
+                ))
+                for i, b in enumerate(("drip", "drip", "mute", "mute"))
+            ]
+            metrics["byzantine"] = len(byz)
+            metrics["deadline_epochs"] = params.read_deadline_epochs
+            # hold the window past the deadline plus slack: a reap
+            # cannot land before the deadline's epochs have elapsed
+            fault_hold = max(fault, (
+                params.read_deadline_epochs + 3
+            ) * params.epoch_seconds)
         elif name == "fleet_partition":
             # cut HALF the fleet's links — by source port, the identity
             # on localhost — and hold the blackout PAST the loss
@@ -2325,6 +2490,13 @@ async def _chaos_fleet_cell(
             # read the probe BEFORE the drain/teardown: only losses
             # declared while the link was flapping count against it
             metrics["lost_during_flap"] = lost_events["n"]
+        if name == "slow_loris":
+            # server-side reaps (deadline declare_lost events): honest
+            # traffic produces none (graceful closes are suppressed, as
+            # the flapping_link cell pins), so every event here is a
+            # loris kill. Actor-observed drops ride along as a probe.
+            metrics["lorises_dropped"] = lost_events["n"]
+            metrics["loris_self_observed"] = loris_drops["n"]
         if plan is not None:
             metrics["plan_stats"] = dict(plan.stats)
         if coord._journal is not None:
@@ -2649,6 +2821,17 @@ def chaos_check(metrics: dict, params: Params = FAST) -> list:
                 bad.append(
                     pre + "no chunk from a cut miner was requeued onto "
                     "the surviving half of the fleet"
+                )
+        elif cell == "slow_loris":
+            if m.get("lorises_dropped", 0) <= 0:
+                bad.append(
+                    pre + "no slow-loris connection was ever reaped: "
+                    "the read/first-message deadlines never fired"
+                )
+            if m.get("deadline_epochs", 0) <= 0:
+                bad.append(
+                    pre + "the cell ran with the deadline disarmed — "
+                    "it measured nothing"
                 )
         elif cell == "flapping_link":
             if m.get("lost_during_flap", 0) > 0:
@@ -3304,7 +3487,7 @@ def main(argv=None) -> int:
         "--scenario",
         choices=(
             "steady", "crash", "failover", "chaos", "zipf", "churn",
-            "rolled", "workload",
+            "rolled", "workload", "chain-host",
         ),
         default="steady",
         help="steady: the sustained-burst benchmark; crash: kill the "
@@ -3433,8 +3616,31 @@ def main(argv=None) -> int:
              "(tpuminter.analysis.affinity) for the crash/failover "
              "drills; --smoke then fails on any cross-loop mutation",
     )
+    parser.add_argument(
+        "--hops", type=int, default=2,
+        help="chain-host scenario: chained standby hops to serve",
+    )
+    parser.add_argument(
+        "--wal-dir", default=None,
+        help="chain-host scenario: directory for the hop WAL files",
+    )
+    parser.add_argument(
+        "--port-file", default=None,
+        help="chain-host scenario: file the entry hop's port is "
+        "written to once the chain is listening",
+    )
     parser.add_argument("--json", action="store_true", help="JSON output")
     args = parser.parse_args(argv)
+    if args.scenario == "chain-host":
+        if not args.wal_dir or not args.port_file:
+            parser.error("chain-host requires --wal-dir and --port-file")
+        try:
+            asyncio.run(run_chain_host(
+                args.hops, args.wal_dir, args.port_file
+            ))
+        except KeyboardInterrupt:
+            pass
+        return 0
     knobs = dict(
         binary=args.codec == "binary", pipeline_depth=args.pipeline,
         loops=args.loops, io_batch=args.io_batch == "on",

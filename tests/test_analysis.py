@@ -331,6 +331,35 @@ def test_bounded_state_catches_unbounded_table():
     assert all(f.qualname == "Registry.__init__" for f in findings)
 
 
+def test_bounded_state_covers_the_aggregator_tables():
+    """ISSUE 18: the aggregator is stamped, so the checker's lifetime
+    oracle puts its lease/beacon/template tables IN SCOPE — and each
+    one carries a real eviction seam in the class body. If a future
+    table lands without its seam, the tree-clean gate above fails; this
+    test pins that the coverage itself can't silently lapse (an
+    unstamped Aggregator would pass tree-clean by being invisible)."""
+    import ast
+
+    from tpuminter.analysis import bounded_state
+
+    src = parse_module(
+        REPO_ROOT, os.path.join("tpuminter", "federation", "aggregator.py")
+    )
+    agg = next(
+        n for n in ast.walk(src.tree)
+        if isinstance(n, ast.ClassDef) and n.name == "Aggregator"
+    )
+    init = next(
+        n for n in agg.body
+        if isinstance(n, ast.FunctionDef) and n.name == "__init__"
+    )
+    assert bounded_state._calls_stamp(init), "Aggregator lost its stamp"
+    seams = bounded_state._evicted_attrs(agg)
+    for table in ("_templates", "_leases", "_lease_tasks", "_beacon_hw"):
+        assert table in seams, f"{table} lost its eviction seam"
+    assert bounded_state.check_module(src) == []
+
+
 # ---------------------------------------------------------------------------
 # (3) runtime loop-affinity detector
 # ---------------------------------------------------------------------------

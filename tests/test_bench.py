@@ -91,3 +91,16 @@ def test_bench_smoke_emits_one_json_line():
     assert obj["extra"]["workload_dev_equal"] is True
     assert obj["extra"]["workload_dev_width"] % 128 == 0
     assert obj["extra"]["workload_dev_engine"] in ("jnp", "pallas")
+    # the federation section rides every capture (ISSUE 18): the
+    # parent's control cost per settled segment stays within 2x as the
+    # fleet behind one aggregator grows (the merged-beacon flattening),
+    # the chain-replication primary paid for exactly ONE stream, and
+    # the two-process end-to-end overhead was measured (its value
+    # carries this one-core host's ambient swing, like
+    # replication_overhead_pct, so only its presence is gated)
+    assert obj["extra"]["fed_parent_msgs_per_segment_fleet1"] > 0
+    assert obj["extra"]["fed_fanin_msgs_ratio"] <= 2.0
+    assert obj["extra"]["fed_chain_one_primary_stream"] is True
+    assert isinstance(
+        obj["extra"]["fed_chain_overhead_pct"], (int, float)
+    )

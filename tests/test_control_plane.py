@@ -327,10 +327,11 @@ def test_byzantine_eviction_requeues_and_job_finishes_exact():
 
 
 def test_loadgen_chaos_smoke_gate(capsys):
-    """The tier-1 chaos gate (ISSUE 12 satellite): ``--scenario chaos
-    --smoke`` runs the netsplit + byzantine cells with the full
-    ``chaos_check`` assertions behind rc — exactly-once ledger, split
-    brain contained, forged answers contained, offenders evicted —
+    """The tier-1 chaos gate (ISSUE 12 satellite; slow-loris cell added
+    by ISSUE 18): ``--scenario chaos --smoke`` runs the netsplit +
+    byzantine + slow_loris cells with the full ``chaos_check``
+    assertions behind rc — exactly-once ledger, split brain contained,
+    forged answers contained, offenders evicted, lorises reaped —
     reproducible from ``--seed``."""
     import json as _json
 
@@ -342,7 +343,7 @@ def test_loadgen_chaos_smoke_gate(capsys):
     assert rc == 0, f"chaos smoke gate failed: {out}"
     metrics = _json.loads(out.splitlines()[0])
     assert metrics["seed"] == 3
-    assert metrics["cells"] == ["netsplit", "byzantine"]
+    assert metrics["cells"] == ["netsplit", "byzantine", "slow_loris"]
     ns = metrics["results"]["netsplit"]
     # the exactly-once ledger held across the split (chaos_check
     # enforces the same behind rc; re-asserted so a loosened check
@@ -362,6 +363,12 @@ def test_loadgen_chaos_smoke_gate(capsys):
     assert bz["miners_evicted"] > 0
     assert bz["results_rejected"] > 0
     assert bz["chunks_requeued"] > 0
+    sl = metrics["results"]["slow_loris"]
+    assert sl["answered"] > 0
+    assert sl["answers_lost"] == 0
+    assert sl["answers_duplicated"] == 0
+    assert sl["lorises_dropped"] > 0
+    assert sl["deadline_epochs"] > 0
 
 
 # ---------------------------------------------------------------------------

@@ -45,6 +45,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 from tpuminter import chain
 from tpuminter import workloads
 from tpuminter.analysis import affinity
+from tpuminter.federation import steal as steal_policy
 from tpuminter.journal import (
     WINNERS_CAP,
     Journal,
@@ -68,6 +69,7 @@ from tpuminter.protocol import (
     Result,
     RollAssign,
     Setup,
+    Steal,
     WorkResult,
     decode_msg,
     encode_msg,
@@ -217,6 +219,11 @@ class _MinerState:
     #: primary or hedge — to a miner whose set contains it; mining jobs
     #: ("" workload) go anywhere. Same no-flag-day shape as ``roll``.
     workloads: frozenset = frozenset()
+    #: non-empty = this "worker" is a federation aggregator (Join.agg,
+    #: ISSUE 18): its rolled dispatches carry a lease epoch it must
+    #: echo on Beacons, and its Steal messages are honored. Plain
+    #: workers never see an epoch — no flag day, same as ``roll``.
+    agg: str = ""
     #: outstanding dispatches, oldest first:
     #: chunk_id → (job_id, lower, upper, dispatched_at). The chunk_id
     #: lets a Result be matched to the exact dispatch it answers: after
@@ -338,6 +345,11 @@ class _Job:
     #: Fold interface, never anything workload-specific.
     discipline: Optional[workloads.Fold] = None
     wstate: Optional[dict] = None
+    #: federation fencing (ISSUE 18): bumped on every sibling steal of
+    #: one of this job's chunks; the epoch stamped on a RollAssign to
+    #: an aggregator is the value at dispatch time, and a Beacon
+    #: echoing any other value is a fenced-off loser's
+    lease_epoch: int = 0
 
     @property
     def workload(self) -> str:
@@ -414,6 +426,7 @@ class Coordinator:
         winners_ttl: float = 0.0,
         unbound_ttl: float = 0.0,
         roll_budget: int = 0,
+        steal_after: Optional[float] = None,
     ):
         self._server = server
         self._chunk_size = chunk_size
@@ -432,6 +445,31 @@ class Coordinator:
         #: double-counted (``_accept_result`` subtracts). Popped on
         #: every path a chunk leaves the books by.
         self._beacon_settled: Dict[int, int] = {}
+        # -- federation (ISSUE 18) ------------------------------------
+        if steal_after is not None and steal_after <= 0:
+            raise ValueError(
+                "steal_after must be positive seconds (or None to disable)"
+            )
+        #: seconds a rolled dispatch must sit progress-free before a
+        #: sibling aggregator's Steal may re-lease its suffix; None
+        #: (default) denies every Steal — work-stealing is an operator
+        #: opt-in exactly like hedging (it duplicates work at the tail)
+        self._steal_after = steal_after
+        #: chunk_id → lease epoch AS SENT on its RollAssign (stamped
+        #: only toward aggregator peers; absent ⇒ expected echo is 0,
+        #: which is what plain workers send). Popped on every path a
+        #: chunk leaves the books by, same as _beacon_settled.
+        self._lease_epochs: Dict[int, int] = {}
+        #: recently re-leased chunk ids: attributes a fenced loser's
+        #: late Result to the steal that orphaned it (bounded —
+        #: correctness rides chunk-id uniqueness, not this table)
+        self._stolen = steal_policy.StolenRegistry()
+        #: parent-lease records replayed from this journal (raw dicts,
+        #: keyed by parent chunk id) — populated by _adopt, consumed
+        #: and cleared by the federation aggregator's one-sided
+        #: recovery (it DROPS each open lease; see federation.lease).
+        #: Empty forever on a non-aggregator coordinator.
+        self.recovered_leases: Dict[int, dict] = {}
         # -- admission & fairness (ISSUE 13) --------------------------
         if quota_rate < 0 or quota_burst < 1:
             raise ValueError("quota_rate must be >= 0, quota_burst >= 1")
@@ -646,6 +684,17 @@ class Coordinator:
             #: progress Beacons booked as partial settles
             "chunks_roll_dispatched": 0,
             "beacons_accepted": 0,
+            #: federation (ISSUE 18): rolled dispatches that went to an
+            #: aggregator under a lease epoch; suffixes re-leased to a
+            #: sibling via Steal; Steals denied (disabled / no victim);
+            #: and the fencing evidence the two-tier drill reads —
+            #: epoch-mismatched Beacons and post-steal stale Results
+            #: rejected instead of double-counted
+            "leases_delegated": 0,
+            "chunks_stolen": 0,
+            "steals_denied": 0,
+            "beacons_fenced": 0,
+            "results_fenced": 0,
         }
         # TPUMINTER_LOOP_AFFINITY=1: the coordinator is single-loop by
         # contract (one per shard in multiloop); any mutation arriving
@@ -681,6 +730,7 @@ class Coordinator:
         winners_ttl: float = 0.0,
         unbound_ttl: float = 0.0,
         roll_budget: int = 0,
+        steal_after: Optional[float] = None,
     ) -> "Coordinator":
         """``recover_from`` names a write-ahead journal file
         (``tpuminter.journal``): if it exists its records are replayed —
@@ -714,7 +764,7 @@ class Coordinator:
             quota_tiers=quota_tiers, max_jobs=max_jobs,
             retry_after_ms=retry_after_ms, winners_cap=winners_cap,
             winners_ttl=winners_ttl, unbound_ttl=unbound_ttl,
-            roll_budget=roll_budget,
+            roll_budget=roll_budget, steal_after=steal_after,
         )
         if recovered is not None:
             coord._adopt(recovered)
@@ -825,6 +875,7 @@ class Coordinator:
             elif job.exhausted:
                 # fully settled pre-crash, finish record lost
                 finish_now.append((job, None))
+        self.recovered_leases.update(recovered.leases)
         if recovered.jobs:
             log.info(
                 "recovered %d live job(s) and %d acknowledged winner(s) "
@@ -1057,6 +1108,8 @@ class Coordinator:
             self._on_refuse(conn_id, msg)
         elif isinstance(msg, Join):
             self._on_join(conn_id, msg)
+        elif isinstance(msg, Steal):
+            self._on_steal(conn_id, msg)
         elif isinstance(msg, Request):
             self._on_request(conn_id, msg)
         elif isinstance(msg, RepHello):
@@ -1259,16 +1312,19 @@ class Coordinator:
             # registry also knows — an id neither side can resolve must
             # never route work
             workloads=frozenset(msg.workloads) & set(workloads.names()),
+            # aggregator hello (ISSUE 18): epoch-stamped leases + Steal
+            agg=msg.agg,
         )
         self._miners[conn_id] = miner
         self._idle[conn_id] = miner
         log.info(
-            "miner %d joined (backend=%s, lanes=%d, span=%d, codec=%s%s%s)",
+            "miner %d joined (backend=%s, lanes=%d, span=%d, codec=%s%s%s%s)",
             conn_id, msg.backend, msg.lanes, msg.span,
             "bin" if miner.binary else "json",
             ", roll" if miner.roll else "",
             (", workloads=" + ",".join(sorted(miner.workloads)))
             if miner.workloads else "",
+            f", agg={miner.agg}" if miner.agg else "",
         )
         self._schedule_dispatch()
 
@@ -1282,6 +1338,7 @@ class Coordinator:
         ``miner.chunks``."""
         job_id, lo, hi, _at = entry
         self._beacon_settled.pop(chunk_id, None)
+        self._lease_epochs.pop(chunk_id, None)
         audit = self._audits.pop(chunk_id, None)
         if audit is not None:
             self._audit_queue.append(audit)  # retry on another worker
@@ -1631,6 +1688,12 @@ class Coordinator:
             # miners a chance at queued work before returning (ADVICE.md
             # r1: returning early here could strand queued jobs until an
             # unrelated event).
+            if msg.chunk_id in self._stolen:
+                # a steal loser's late answer: rejected (the thief's
+                # verified settle is the only one that books), never
+                # double-counted — the exactly-once evidence the
+                # federation drill asserts on
+                self.stats["results_fenced"] += 1
             self._schedule_dispatch()
             return
         job_id, lo, hi, dispatched_at = entry
@@ -1699,6 +1762,11 @@ class Coordinator:
             return
         entry = miner.chunks.get(msg.chunk_id)
         if entry is None or msg.chunk_id in self._audits:
+            if entry is None and msg.chunk_id in self._stolen:
+                # the loser of a sibling steal still reporting progress
+                # on a re-leased chunk: rejected, and attributed so the
+                # two-tier drill can see the fence working
+                self.stats["beacons_fenced"] += 1
             return  # stale (chunk settled/cancelled) or an audit
         job_id, lo, hi, _at = entry
         job = self._jobs.get(job_id)
@@ -1709,6 +1777,14 @@ class Coordinator:
             # only rolled fast-dialect chunks beacon; anything else is a
             # confused or malicious peer (and a scrypt verify must never
             # run inline on the loop)
+            return
+        if msg.lease_epoch != self._lease_epochs.get(msg.chunk_id, 0):
+            # lease-epoch fence (ISSUE 18): the echo does not match the
+            # epoch this chunk was leased under — a steal re-leased the
+            # range and this is the loser still reporting, or a peer
+            # replaying a stale lease across its restart. Its settles
+            # must not book: the thief owns the suffix now.
+            self.stats["beacons_fenced"] += 1
             return
         hw = msg.high_water
         if not lo <= hw < hi:
@@ -1741,6 +1817,73 @@ class Coordinator:
         self._beacon_settled[msg.chunk_id] = (
             self._beacon_settled.get(msg.chunk_id, 0) + searched
         )
+
+    def _on_steal(self, conn_id: int, msg: Steal) -> None:
+        """Sibling work-stealing (ISSUE 18): an idle aggregator asks to
+        re-lease the un-beaconed suffix of a slow sibling's assignment.
+
+        The policy (``federation.steal.pick_victim``) picks the oldest
+        progress-free rolled dispatch; this side does the surgery: pop
+        the victim's chunk from every book (its late Beacons/Results
+        now fail the chunk-id match — see ``_stolen`` for attribution),
+        bump the job's lease epoch so the re-lease is wire-visibly a
+        NEW lease, and dispatch the suffix to the thief directly. The
+        victim is NOT cancelled: letting its stale answer arrive and be
+        rejected is the exactly-once evidence the drill asserts (and a
+        Cancel is job-scoped — it would strip chunks the victim still
+        rightfully holds)."""
+        thief = self._miners.get(conn_id)
+        if (
+            thief is None or not thief.agg or not thief.roll
+            or not thief.has_capacity or self._steal_after is None
+        ):
+            self.stats["steals_denied"] += 1
+            return
+        victim = steal_policy.pick_victim(
+            self._miners, self._jobs, self._audits,
+            thief_conn=conn_id, steal_after=self._steal_after,
+            job_id=msg.job_id,
+        )
+        if victim is None:
+            self.stats["steals_denied"] += 1
+            return
+        vconn, chunk_id, job_id, lo, hi = victim
+        job = self._jobs[job_id]
+        vminer = self._miners.get(vconn)
+        if vminer is not None:
+            vminer.chunks.pop(chunk_id, None)
+            self._mark_idle(vminer)
+        job.inflight.pop(chunk_id, None)
+        self._beacon_settled.pop(chunk_id, None)
+        self._lease_epochs.pop(chunk_id, None)
+        job.lease_epoch += 1
+        self._stolen.add(chunk_id, job.lease_epoch)
+        # directed dispatch of the suffix, mirroring _dispatch's carve:
+        # the thief may not take the whole range in one chunk — the
+        # remainder requeues for the normal scheduler (which may well
+        # hand it back to the thief's pipeline next pass)
+        roll = self._roll_carve(thief, job, lo, hi)
+        if roll is not None:
+            chunk_hi = chain.roll_span(
+                roll[0], roll[1], job.request.nonce_bits
+            )[1]
+        else:
+            take = min(hi - lo + 1, self._budget(thief, job))
+            chunk_hi = lo + take - 1
+        if chunk_hi < hi:
+            self._requeue_chunk(job, chunk_hi + 1, hi)
+        if self._assign(thief, job, lo, chunk_hi, roll=roll):
+            self.stats["chunks_stolen"] += 1
+            log.info(
+                "aggregator %d (%s) stole [%d, %d] of job %d from "
+                "miner %d (lease epoch now %d)",
+                conn_id, thief.agg, lo, chunk_hi, job_id, vconn,
+                job.lease_epoch,
+            )
+        else:
+            # thief died between Steal and dispatch: back to the queue
+            self._requeue_chunk(job, lo, chunk_hi)
+        self._schedule_dispatch()
 
     async def _settle_offloaded(
         self, conn_id: int, job_id: int, lo: int, hi: int,
@@ -1836,6 +1979,7 @@ class Coordinator:
         # it) — subtract so nothing double-counts. A zero-searched
         # (sentinel-accounting) Result books the residual range.
         settled = self._beacon_settled.pop(msg.chunk_id, 0)
+        self._lease_epochs.pop(msg.chunk_id, None)
         searched = (
             max(0, msg.searched - settled) if msg.searched > 0
             else hi - lo + 1
@@ -1908,6 +2052,7 @@ class Coordinator:
         # beacon-settled prefixes stay settled (each was independently
         # verified and journaled); only the residual [lo, hi] re-mines
         self._beacon_settled.pop(msg.chunk_id, None)
+        self._lease_epochs.pop(msg.chunk_id, None)
         self.stats["results_rejected"] += 1
         self._requeue_chunk(job, lo, hi)
         miner = self._miners.get(conn_id)
@@ -2024,7 +2169,11 @@ class Coordinator:
             job.setup_sent.add(miner.conn_id)
         if roll is not None:
             e0, count = roll
-            out = RollAssign(job.job_id, chunk_id, e0, count)
+            # lease-epoch stamping (ISSUE 18): only aggregator peers —
+            # a plain worker would choke on the unknown field/tag, and
+            # it has no sibling to be fenced against anyway
+            ep = job.lease_epoch if miner.agg else 0
+            out = RollAssign(job.job_id, chunk_id, e0, count, lease_epoch=ep)
         else:
             out = Assign(job.job_id, chunk_id, lo, hi)
         self._server.write(
@@ -2402,6 +2551,7 @@ class Coordinator:
         for chunk_id, (miner_conn, _lo, _hi) in list(job.inflight.items()):
             job.inflight.pop(chunk_id, None)
             self._beacon_settled.pop(chunk_id, None)
+            self._lease_epochs.pop(chunk_id, None)
             miner = self._miners.get(miner_conn)
             if miner is not None and miner.chunks.pop(chunk_id, None) is not None:
                 self._mark_idle(miner)
@@ -2608,6 +2758,15 @@ class Coordinator:
             self.stats["dispatches_pipelined"] += 1
         if roll is not None:
             self.stats["chunks_roll_dispatched"] += 1
+            if miner.agg:
+                self.stats["leases_delegated"] += 1
+                if job.lease_epoch:
+                    # record the epoch AS SENT: the Beacon echo check
+                    # compares against this, not the job's live
+                    # counter — a chunk leased before a steal keeps
+                    # its old stamp and is exactly the one the fence
+                    # must catch (absent entry ⇒ expected echo 0)
+                    self._lease_epochs[chunk_id] = job.lease_epoch
         if self._journal_assigns:
             self._journal_append("assign", {
                 "id": job.job_id, "c": chunk_id, "lo": lo, "hi": hi,
@@ -2706,6 +2865,7 @@ class Coordinator:
                 m.chunks.pop(cid, None)
                 job.inflight.pop(cid, None)
                 self._beacon_settled.pop(cid, None)
+                self._lease_epochs.pop(cid, None)
             # The Cancel below is JOB-scoped: the loser abandons
             # whatever chunk of this job it is currently mining
             # (sending nothing back) and Refuses any queued Assigns
@@ -2764,6 +2924,14 @@ def main(argv: Optional[list] = None) -> None:
         "carving sends thousands — with sub-chunk progress Beacons "
         "journaled as partial settles (0 = off, the global-index "
         "baseline; README 'Roll-budget chunks')",
+    )
+    parser.add_argument(
+        "--steal-after", type=float, default=None, metavar="SECONDS",
+        help="honor sibling aggregators' Steal requests: a rolled "
+        "dispatch with no progress for this many seconds may have its "
+        "un-beaconed suffix re-leased to an idle aggregator under a "
+        "bumped lease epoch (default off — stealing duplicates work "
+        "at the tail, an opt-in like --hedge-after)",
     )
     parser.add_argument(
         "--stats-port", type=int, default=None, metavar="PORT",
@@ -2932,6 +3100,7 @@ def main(argv: Optional[list] = None) -> None:
                 replica_ack=args.replica_ack,
                 io_batch=args.io_batch == "on",
                 roll_budget=args.roll_budget,
+                steal_after=args.steal_after,
                 **admission,
             )
             log.info(
@@ -2970,6 +3139,7 @@ def main(argv: Optional[list] = None) -> None:
             replica_ack=args.replica_ack,
             io_batch=args.io_batch == "on",
             roll_budget=args.roll_budget,
+            steal_after=args.steal_after,
             **admission,
         )
         log.info("coordinator listening on port %d", coord.port)

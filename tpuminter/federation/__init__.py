@@ -1,0 +1,39 @@
+"""Federation tier (ISSUE 18): aggregators between clients and the root.
+
+One coordinator — however fast — is the wrong shape for a million-client
+fleet. Production mining pools interpose proxy/aggregator tiers; this
+package is that tier for tpuminter. An :class:`~tpuminter.federation.
+aggregator.Aggregator` presents itself to a parent coordinator as a
+single ``worker`` (Join / RollAssign lease / Beacon upward, taking
+whole-extranonce leases via the PR 11 roll budget) while running the
+full coordinator protocol downward to its local fleet — carving its
+lease into sub-assignments, folding child results through the PR 12
+coverage-gated fold registry so exactly-once composes across the tree,
+and emitting merged Beacons at bounded cadence so the parent's control
+cost stays ~constant regardless of fan-in.
+
+Module map (import ``aggregator`` directly — it pulls in the
+coordinator, which itself imports :mod:`steal`, so the package root
+stays cycle-free):
+
+- :mod:`tpuminter.federation.lease` — the durable parent-lease record
+  an aggregator journals before dispatching downward, and its
+  journal-record codec ("lease"/"lease_end" kinds).
+- :mod:`tpuminter.federation.steal` — sibling work-stealing policy:
+  pick the un-beaconed suffix of the slowest peer's assignment for
+  re-lease under a bumped lease epoch.
+- :mod:`tpuminter.federation.aggregator` — the node itself.
+
+**Lease-epoch fencing.** Every rolled dispatch to an aggregator peer
+carries ``RollAssign.lease_epoch``; the aggregator echoes it on every
+upward Beacon. A steal bumps the job's epoch, so the loser's late
+Beacons fail the echo check at the parent and its late Result fails the
+chunk-id match — rejected, never double-counted. Chunk ids alone
+already fence (they are never reused); the epoch makes the fencing
+*wire-visible and durable*, so an aggregator that recovers its journal
+can tell a stale lease from a live one without asking.
+"""
+
+from tpuminter.federation import lease, steal
+
+__all__ = ["lease", "steal"]

@@ -1,0 +1,76 @@
+"""Durable parent-lease records for the aggregator tier (ISSUE 18).
+
+An aggregator holds work on *credit*: the parent booked a RollAssign /
+Assign against it as if it were one worker, and the aggregator re-carves
+that range for its local fleet. The lease record is the durable link
+between the two books — journaled (fsynced by the same group-commit
+machinery as every settle) before the first downward dispatch, ended
+when the final upward Result is written.
+
+Recovery semantics are deliberately one-sided: a restarted aggregator
+DROPS every open lease (abandoning the matching inner job) instead of
+resuming it. The parent observed the connection loss and already
+requeued the chunk — possibly to a sibling, under a bumped lease epoch —
+so resuming would mine a range someone else now owns. What the record
+buys is *bounded, observable* teardown: the restarted node knows exactly
+which inner jobs were lease-backed and retires them instead of leaking
+them as UNBOUND residue.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+__all__ = ["Lease", "lease_record", "lease_end_record"]
+
+
+@dataclass
+class Lease:
+    """One parent chunk held by this aggregator.
+
+    ``parent_chunk_id`` is the parent's dispatch id — the key both
+    sides fence on. ``lower``/``upper`` are GLOBAL indices (the
+    RollAssign already expanded via ``chain.roll_span``), so the inner
+    job's coverage arithmetic is dialect-blind, same as the
+    coordinator's own books. ``inner_job_id`` is the aggregator-side
+    job mining it; 0 until submitted."""
+
+    parent_job_id: int
+    parent_chunk_id: int
+    lower: int
+    upper: int
+    lease_epoch: int = 0
+    inner_job_id: int = 0
+
+    @classmethod
+    def from_record(cls, obj: dict) -> "Lease":
+        """Typed view of one replayed journal record
+        (``RecoveredState.leases`` stores the raw dicts). Unknown keys
+        default safely — a v-next record with extra fields still
+        replays here."""
+        return cls(
+            parent_job_id=int(obj.get("pj", 0)),
+            parent_chunk_id=int(obj.get("pc", 0)),
+            lower=int(obj.get("lo", 0)),
+            upper=int(obj.get("hi", 0)),
+            lease_epoch=int(obj.get("le", 0)),
+            inner_job_id=int(obj.get("ij", 0)),
+        )
+
+
+def lease_record(lease: Lease) -> dict:
+    """Journal payload for the "lease" kind (short keys like every
+    other record: this is the WAL hot path)."""
+    return {
+        "pj": lease.parent_job_id,
+        "pc": lease.parent_chunk_id,
+        "lo": lease.lower,
+        "hi": lease.upper,
+        "le": lease.lease_epoch,
+        "ij": lease.inner_job_id,
+    }
+
+
+def lease_end_record(parent_chunk_id: int) -> dict:
+    """Journal payload for the "lease_end" kind."""
+    return {"pc": parent_chunk_id}

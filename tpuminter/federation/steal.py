@@ -1,0 +1,109 @@
+"""Sibling work-stealing policy for the aggregator tier (ISSUE 18).
+
+When one aggregator's fleet drains early while a sibling's lease drags,
+the idle one sends the parent a ``Steal`` and the parent re-leases the
+*un-beaconed suffix* of the slowest live assignment to it, under a
+bumped lease epoch. The loser keeps mining uselessly for a moment, but
+its late Beacons fail the epoch echo and its late Result fails the
+chunk-id match — rejected, never double-counted (the exactly-once drill
+in scripts/loadgen.py asserts exactly this).
+
+This module is pure policy — no I/O, no coordinator import (the
+coordinator imports *us*) — so the victim choice is unit-testable
+against hand-built books.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+from tpuminter.protocol import PowMode
+
+__all__ = ["pick_victim", "StolenRegistry", "STOLEN_CAP"]
+
+#: recently-stolen chunk ids remembered for observable late-result
+#: rejection (``results_fenced``). Bounded: fencing CORRECTNESS comes
+#: from chunk-id uniqueness (a settled dispatch id never matches
+#: again); this table only attributes the rejection, so evicting an
+#: old entry costs one stat, never a double count.
+STOLEN_CAP = 1024
+
+#: (conn_id, chunk_id, job_id, lower, upper) — the victim pick
+Victim = Tuple[int, int, int, int, int]
+
+
+def pick_victim(
+    miners: Dict[int, object],
+    jobs: Dict[int, object],
+    audits: Dict[int, object],
+    *,
+    thief_conn: int,
+    steal_after: float,
+    now: Optional[float] = None,
+    job_id: int = 0,
+) -> Optional[Victim]:
+    """Choose the chunk a ``Steal`` re-leases, or None to deny.
+
+    The pick is the OLDEST qualifying dispatch — and "age" here is time
+    since last *progress*, not since dispatch, because an accepted
+    Beacon refreshes the chunk's timestamp in place: a slow-but-
+    beaconing worker is progressing, not straggling, and must not be
+    robbed (the same insight the hedger uses).
+
+    Qualifying means: held by someone other than the thief; not an
+    audit (tiny, evidence-bearing); a live rolled non-scrypt job (the
+    suffix must be re-leasable as whole extranonce segments, and a
+    scrypt chunk is deliberately small); an un-beaconed suffix of at
+    least one whole segment (below that the remainder finishes sooner
+    than a re-lease round-trips); and stalled past ``steal_after``
+    seconds. ``job_id`` narrows to one job when non-zero (the wire
+    Steal's optional filter)."""
+    if now is None:
+        now = time.monotonic()
+    best: Optional[Tuple[float, Victim]] = None
+    for miner in miners.values():
+        if miner.conn_id == thief_conn:
+            continue
+        for cid, (jid, lo, hi, at) in miner.chunks.items():
+            if cid in audits:
+                continue
+            if job_id and jid != job_id:
+                continue
+            if now - at <= steal_after:
+                continue
+            job = jobs.get(jid)
+            if job is None or job.done:
+                continue
+            req = job.request
+            if not req.rolled or req.mode == PowMode.SCRYPT:
+                continue
+            if hi - lo + 1 < (1 << req.nonce_bits):
+                continue  # sub-segment suffix: let the holder finish
+            if best is None or at < best[0]:
+                best = (at, (miner.conn_id, cid, jid, lo, hi))
+    return best[1] if best is not None else None
+
+
+class StolenRegistry:
+    """Bounded memory of re-leased chunk ids, for attributing the
+    loser's late Results to the steal that orphaned them."""
+
+    def __init__(self, cap: int = STOLEN_CAP):
+        if cap < 1:
+            raise ValueError("cap must be >= 1")
+        self._cap = cap
+        self._ids: "OrderedDict[int, int]" = OrderedDict()
+
+    def add(self, chunk_id: int, lease_epoch: int) -> None:
+        self._ids[chunk_id] = lease_epoch
+        self._ids.move_to_end(chunk_id)
+        while len(self._ids) > self._cap:
+            self._ids.popitem(last=False)
+
+    def __contains__(self, chunk_id: int) -> bool:
+        return chunk_id in self._ids
+
+    def __len__(self) -> int:
+        return len(self._ids)

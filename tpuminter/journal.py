@@ -40,6 +40,13 @@ only look like *loss of a suffix*, never like different records.
   re-derivable (job replayed, re-mined) or in the winners table and
   re-delivered when the client re-submits its request id.
 - ``abandon``  — job dropped (anonymous client died).
+- ``lease`` / ``lease_end`` — federation only (ISSUE 18): a parent
+  coordinator's chunk this aggregator holds on credit, journaled
+  before the first downward dispatch and ended with the final upward
+  Result. Replay surfaces still-open leases so a restarted aggregator
+  can retire their inner jobs instead of leaking them (it never
+  *resumes* them — the parent already requeued on connection loss).
+  Non-federation journals never contain these kinds.
 - ``snapshot`` — a compacting checkpoint of the whole replayable state;
   replay resets to it and applies subsequent records on top.
 
@@ -420,6 +427,11 @@ class RecoveredState:
     )
     #: job ids seen finishing/abandoned — guards job-record idempotency
     finished: Set[int] = field(default_factory=set)
+    #: federation (ISSUE 18): parent leases still open at the crash,
+    #: parent_chunk_id → raw lease-record dict (see
+    #: tpuminter.federation.lease for the typed view). Empty for every
+    #: non-aggregator journal.
+    leases: Dict[int, dict] = field(default_factory=dict)
     records: int = 0
     #: size bound applied to ``winners`` while folding records (ISSUE
     #: 13: cap-aware replay — a coordinator running a smaller dedup
@@ -447,6 +459,9 @@ class RecoveredState:
             # already contains (complete job+finish pairs or finish-only
             # tails), so the guard restarts empty
             self.finished = set()
+            self.leases = {
+                int(l["pc"]): dict(l) for l in rec.get("leases", [])
+            }
         elif k == "job":
             job_id = int(rec["id"])
             self.next_job_id = max(self.next_job_id, job_id + 1)
@@ -497,13 +512,22 @@ class RecoveredState:
             job_id = int(rec["id"])
             self.jobs.pop(job_id, None)
             self.finished.add(job_id)
+        elif k == "lease":
+            # federation (ISSUE 18): keep the raw record — the typed
+            # view lives in tpuminter.federation.lease, and the journal
+            # stays schema-agnostic about fields it only round-trips
+            self.leases[int(rec["pc"])] = {
+                key: rec[key] for key in rec if key != "k"
+            }
+        elif k == "lease_end":
+            self.leases.pop(int(rec.get("pc", 0)), None)
         # assign / requeue / bind: observability records; coverage is
         # derived from settles (every un-settled range re-mines anyway)
 
     def snapshot_obj(self) -> dict:
         """The compacting checkpoint equivalent to this state (minus the
         boot epoch, which compaction writes as its own ``boot`` record)."""
-        return {
+        obj = {
             "k": "snapshot",
             "next": self.next_job_id,
             "jobs": [j.to_obj() for j in self.jobs.values()],
@@ -511,6 +535,12 @@ class RecoveredState:
                 [ck, cj, w] for (ck, cj), w in self.winners.items()
             ],
         }
+        if self.leases:
+            # written only when present, so non-federation snapshots
+            # keep their exact historical shape (old journals replay
+            # new snapshots and vice versa)
+            obj["leases"] = list(self.leases.values())
+        return obj
 
 
 def replay(
@@ -567,6 +597,7 @@ def merge_states(states: List[RecoveredState]) -> RecoveredState:
         out.next_job_id = max(out.next_job_id, st.next_job_id)
         out.records += st.records
         out.finished |= st.finished
+        out.leases.update(st.leases)
         for jid, job in st.jobs.items():
             cur = out.jobs.get(jid)
             if cur is None:
@@ -1058,6 +1089,47 @@ class Journal:
                     self.generation += 1
                     self._bytes_since_compact = 0
                     self.stats["compactions"] += 1
+
+    def compact_now(self, snapshot: Optional[dict] = None) -> bool:
+        """Synchronous live compaction for callers that provide their
+        own quiescence — the multiloop writer-mode stop-the-world
+        barrier (ISSUE 18 satellite): every shard is frozen, forwarded
+        batches are already applied, and the caller hands in the merged
+        snapshot covering all of them. Buffered records are flushed to
+        the file FIRST (their durability callbacks fire as usual), then
+        the file is swapped for ``boot ‖ snapshot`` and the offset
+        space switches — same invariants as the flush-loop compaction,
+        minus the executor hop (the caller has already stopped the
+        world; blocking it a millisecond more is the point).
+
+        With no ``snapshot`` argument the instance's
+        ``snapshot_provider`` is used; returns False (and compacts
+        nothing) when neither is available or the journal is dead."""
+        if self._closed or self._crashed or self._failed:
+            return False
+        if snapshot is None:
+            if self.snapshot_provider is None:
+                return False
+            snapshot = self.snapshot_provider()
+        try:
+            self._flush_buffered_sync()
+            blob = encode_record(
+                {"k": "boot", "epoch": self.boot_epoch}
+            ) + encode_record(snapshot)
+            swapped = self._compact_sync(blob)
+        except (OSError, ValueError):
+            self._failed = True
+            log.exception(
+                "journal compaction of %s FAILED — journaling disabled "
+                "for this incarnation", self.path,
+            )
+            return False
+        if swapped:
+            self.size = len(blob)
+            self.generation += 1
+            self._bytes_since_compact = 0
+            self.stats["compactions"] += 1
+        return swapped
 
     def _write_sync(self, blob: bytes, need_sync: bool) -> None:
         if self._crashed:

@@ -150,6 +150,19 @@ class ConnState:
         self._received_this_epoch = False
         self._sends_this_epoch = 0
 
+        # slow-loris deadlines (params.read_deadline_epochs; 0 = off)
+        self._reassembly_epochs = 0  # epochs the CURRENT message has been open
+        self._delivered_any = False  # at least one complete app message in
+        self._epochs_alive = 0
+        #: Server-side handshake deadline, in epochs (0 = off): declare
+        #: the connection lost if no complete app message arrives within
+        #: this many epochs of the handshake. Set by the listening owner
+        #: only — a dialing client may legitimately wait arbitrarily
+        #: long for its first downward message (an idle worker between
+        #: jobs), but every honest inbound peer speaks (Join, Request,
+        #: a WAL batch) immediately after connecting.
+        self.first_msg_deadline_epochs = 0
+
         self.lost = False
         self.closing = False
         #: When true, a loss during close/teardown emits no loss event
@@ -209,6 +222,8 @@ class ConnState:
         if data[:1] == _FINAL:
             parts, self._rx_parts = self._rx_parts, []
             self._rx_bytes = 0
+            self._reassembly_epochs = 0
+            self._delivered_any = True
             # fragments are zero-copy memoryviews into their datagrams
             # (message.decode). A single-fragment message — every hot
             # app message fits one frame — is delivered AS the view:
@@ -372,6 +387,28 @@ class ConnState:
                 )
                 return
         self._received_this_epoch = False
+        # slow-loris deadlines: total-time bounds, deliberately NOT
+        # progress-resetting — a drip-feeder's whole trick is making
+        # one byte of progress per epoch so stall detectors never fire
+        deadline = self.params.read_deadline_epochs
+        if deadline and self._rx_parts:
+            self._reassembly_epochs += 1
+            if self._reassembly_epochs >= deadline:
+                self.declare_lost(
+                    f"message still mid-reassembly after {deadline} epochs"
+                )
+                return
+        self._epochs_alive += 1
+        if (
+            self.first_msg_deadline_epochs
+            and not self._delivered_any
+            and self._epochs_alive >= self.first_msg_deadline_epochs
+        ):
+            self.declare_lost(
+                "no application message within "
+                f"{self.first_msg_deadline_epochs} epochs of the handshake"
+            )
+            return
         # any ack the owner's delay has not flushed yet goes out now
         # (the flush counts as traffic, so it doubles as the heartbeat)
         self.flush_acks()

@@ -26,12 +26,25 @@ class Params:
     max_backoff_interval: int = 0
     #: Cap on in-flight unacked DATA frames; defaults to ``window_size``.
     max_unacked_messages: Optional[int] = None
+    #: Slow-loris bound (ISSUE 18), in epochs; 0 disables. Two deadlines
+    #: hang off it: a message mid-reassembly must COMPLETE within this
+    #: many epochs (total, not stall — a drip-feeder makes just enough
+    #: progress each epoch to evade the silent-epoch check, so only a
+    #: completion deadline catches it), and a server-side connection
+    #: must deliver its first app message within this many epochs of
+    #: the handshake. Honest traffic finishes both in a fraction of one
+    #: epoch; a peer that cannot is buggy or hostile and gets the
+    #: connection declared lost, so a stalled read costs one table
+    #: entry for bounded time.
+    read_deadline_epochs: int = 0
 
     def __post_init__(self) -> None:
         if self.epoch_limit < 1 or self.epoch_millis < 1 or self.window_size < 1:
             raise ValueError("epoch_limit, epoch_millis, window_size must be >= 1")
-        if self.max_backoff_interval < 0:
-            raise ValueError("max_backoff_interval must be >= 0")
+        if self.max_backoff_interval < 0 or self.read_deadline_epochs < 0:
+            raise ValueError(
+                "max_backoff_interval and read_deadline_epochs must be >= 0"
+            )
         if self.max_unacked_messages is None:
             object.__setattr__(self, "max_unacked_messages", self.window_size)
         elif self.max_unacked_messages < 1:

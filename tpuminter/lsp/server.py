@@ -190,6 +190,9 @@ class LspServer:
             send_wires=lambda wires, a=addr: self._send_wires_to(a, wires),
             request_flush=self._schedule_flush,
         )
+        # listener side only: every honest inbound peer speaks an app
+        # message (Join, Request, WAL batch) right after the handshake
+        conn.first_msg_deadline_epochs = self._params.read_deadline_epochs
         self._by_addr[addr] = conn
         self._by_id[conn_id] = conn
         self._addr_of[conn_id] = addr

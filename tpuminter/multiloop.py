@@ -268,7 +268,12 @@ class _JournalProxy:
     ``snapshot_provider`` is absorbed (never installed on the real
     journal): a shard-local snapshot describes one shard, and compacting
     the shared WAL with it would delete the other shards' records —
-    writer-mode compaction is disabled by construction."""
+    the flush-loop's own compaction is disabled by construction.
+    Writer-mode compaction instead runs as a brief stop-the-world
+    barrier (:meth:`MultiLoopCoordinator._compact_stw`, ISSUE 18
+    satellite): every shard freezes, forwards its pending tail, and
+    contributes its absorbed provider's snapshot; the writer merges
+    them and swaps the file synchronously."""
 
     def __init__(
         self, journal: Journal, writer_loop: asyncio.AbstractEventLoop
@@ -374,6 +379,27 @@ class _JournalProxy:
             j.flush_tick()
 
 
+def _merge_snapshot_objs(snaps: List[dict]) -> dict:
+    """Union per-shard snapshot records into the one the shared WAL
+    compacts to. Jobs are shard-affine (disjoint id lanes) so the job
+    lists concatenate; winners replicate to every shard at recovery, so
+    the union is keyed and last-writer-wins (any shard's copy of an
+    acknowledged winner is authoritative — they are immutable)."""
+    out: dict = {"k": "snapshot", "next": 1, "jobs": [], "winners": []}
+    winners: Dict[Tuple[str, int], list] = {}
+    leases: List[dict] = []
+    for snap in snaps:
+        out["next"] = max(out["next"], int(snap.get("next", 1)))
+        out["jobs"].extend(snap.get("jobs", []))
+        for ck, cj, w in snap.get("winners", []):
+            winners[(ck, cj)] = [ck, cj, w]
+        leases.extend(snap.get("leases", []))
+    out["winners"] = list(winners.values())
+    if leases:
+        out["leases"] = leases
+    return out
+
+
 class _AggJournalView:
     """Read-only aggregate over per-segment journals (segments mode) so
     harness code that reads ``coord._journal.stats``/``.size`` works on
@@ -473,6 +499,8 @@ class MultiLoopCoordinator:
         winners_ttl: float = 0.0,
         unbound_ttl: float = 0.0,
         roll_budget: int = 0,
+        steal_after: Optional[float] = None,
+        compact_bytes: Optional[int] = None,
     ) -> "MultiLoopCoordinator":
         if loops < 1:
             raise ValueError("loops must be >= 1")
@@ -516,9 +544,15 @@ class MultiLoopCoordinator:
             states = [replay(scan_file(p)) for p in files + segs]
             merged = merge_states(states) if states else RecoveredState()
             epoch = merged.boot_epoch + 1
+            jkw = (
+                {} if compact_bytes is None
+                else {"compact_bytes": compact_bytes}
+            )
             if journal_mode == "writer":
                 snap = merged.snapshot_obj() if merged.records else None
-                self._journal_real = Journal.fresh(recover_from, epoch, snap)
+                self._journal_real = Journal.fresh(
+                    recover_from, epoch, snap, **jkw
+                )
                 self._journal_real.tick_flush = journal_tick_flush
                 for p in segs:
                     _unlink(p)
@@ -536,7 +570,7 @@ class MultiLoopCoordinator:
                         )
                         snap_k = part.snapshot_obj()
                     self._seg_journals.append(Journal.fresh(
-                        f"{recover_from}.s{k}", epoch, snap_k
+                        f"{recover_from}.s{k}", epoch, snap_k, **jkw
                     ))
                     self._seg_journals[-1].tick_flush = journal_tick_flush
                 _unlink(recover_from)
@@ -569,8 +603,10 @@ class MultiLoopCoordinator:
             quota_tiers=quota_tiers, max_jobs=max_jobs,
             winners_ttl=winners_ttl, unbound_ttl=unbound_ttl,
             # roll-budget carving (ISSUE 14) is shard-local like every
-            # other dispatch decision: a rolled job lives on one shard
+            # other dispatch decision: a rolled job lives on one shard,
+            # and so (ISSUE 18) does a sibling steal of its suffix
             roll_budget=roll_budget,
+            steal_after=steal_after,
         )
         if retry_after_ms is not None:
             coord_kwargs["retry_after_ms"] = retry_after_ms
@@ -758,6 +794,13 @@ class MultiLoopCoordinator:
         serve = asyncio.ensure_future(coordinator.serve())
         sampler = asyncio.ensure_future(self._stall_sampler(shard))
         stop_wait = asyncio.ensure_future(shard.stop.wait())
+        tasks = [sampler, stop_wait, serve]
+        if k == 0 and self._journal_real is not None:
+            # writer-mode live compaction (ISSUE 18 satellite): the
+            # flush-loop path is disabled by construction (see
+            # _JournalProxy), so the writer shard polls the growth
+            # threshold and runs the stop-the-world barrier instead
+            tasks.append(asyncio.ensure_future(self._compaction_ticker()))
         try:
             done, _pending = await asyncio.wait(
                 {serve, stop_wait}, return_when=asyncio.FIRST_COMPLETED
@@ -768,11 +811,9 @@ class MultiLoopCoordinator:
                 )
                 self._signal_failure()
         finally:
-            for task in (sampler, stop_wait, serve):
+            for task in tasks:
                 task.cancel()
-            await asyncio.gather(
-                sampler, stop_wait, serve, return_exceptions=True
-            )
+            await asyncio.gather(*tasks, return_exceptions=True)
             if shard.stop_mode == "close":
                 for lane in shard.lanes:
                     await lane.stop()
@@ -780,6 +821,88 @@ class MultiLoopCoordinator:
                 if k == 0 and self._journal_real is not None:
                     await self._journal_real.aclose()
             # crash mode: the supervisor already ran the kill -9 seams
+
+    async def _compaction_ticker(self) -> None:
+        """Writer-loop poll for WAL growth past the compaction
+        threshold (writer mode only; segment journals compact
+        themselves through the normal flush-loop path). The quarter-
+        second grain bounds how far past the threshold the file can
+        run between checks without taxing the loop it shares."""
+        j = self._journal_real
+        while True:
+            await asyncio.sleep(0.25)
+            if j._closed or j._crashed or j._failed:
+                return
+            if j._bytes_since_compact <= j._compact_bytes:
+                continue
+            try:
+                await self._compact_stw()
+            except Exception:
+                log.exception("stop-the-world WAL compaction failed")
+
+    async def _compact_stw(self) -> None:
+        """Stop-the-world live compaction of the shared writer-mode WAL
+        (ISSUE 18 satellite — today's compaction only ran at restart,
+        which a long-lived production process never does).
+
+        Barrier protocol, from the writer loop: each non-writer shard
+        is frozen by a callback on its own loop that (1) forwards its
+        pending journal tail (one ``call_soon_threadsafe`` onto the
+        writer loop — scheduled BEFORE the shard reports frozen, and
+        the writer's own executor resume is scheduled after, so FIFO
+        ordering guarantees the tail is applied before the snapshot is
+        cut), (2) takes its coordinator's snapshot via the proxy's
+        absorbed provider, then (3) blocks its loop on the release
+        event — the world is stopped. The writer then snapshots its own
+        shard inline (no awaits between that and the swap), merges the
+        per-shard snapshots, and runs :meth:`Journal.compact_now` —
+        buffered records flush to the old file first, then the file is
+        atomically replaced by ``boot ‖ merged snapshot``. Records the
+        swap discards are all covered by some shard's snapshot (state
+        mutates before its record is journaled), which is the same
+        replay-idempotency argument the single-loop compactor makes.
+        The release is in a ``finally``: a failed swap must never leave
+        the fleet frozen."""
+        j = self._journal_real
+        loop = asyncio.get_running_loop()
+        others = [
+            s for s in self._shards
+            if s.index != 0 and s.loop is not None and s.journal is not None
+        ]
+        release = threading.Event()
+        frozen = [threading.Event() for _ in others]
+        snaps: List[Optional[dict]] = [None] * len(others)
+
+        def freeze(i: int, shard: _Shard) -> None:  # runs on shard's loop
+            try:
+                shard.journal.flush_tick()
+                provider = shard.journal.snapshot_provider
+                if provider is not None:
+                    snaps[i] = provider()
+            finally:
+                frozen[i].set()
+                release.wait(10.0)  # brief stop-the-world, bounded
+
+        for i, shard in enumerate(others):
+            try:
+                shard.loop.call_soon_threadsafe(freeze, i, shard)
+            except RuntimeError:
+                frozen[i].set()  # shard loop gone (shutdown race)
+        try:
+            for evt in frozen:
+                # executor wait keeps THIS loop turning so the frozen
+                # shards' forwarded batches (and shard 0's own serve
+                # traffic) keep applying while the barrier assembles
+                await loop.run_in_executor(None, evt.wait, 10.0)
+            await asyncio.sleep(0)
+            parts = [s for s in snaps if s is not None]
+            own = self._shards[0].journal
+            if own is not None and own.snapshot_provider is not None:
+                parts.append(own.snapshot_provider())
+            if parts:
+                j.compact_now(_merge_snapshot_objs(parts))
+        finally:
+            release.set()
 
     def _make_replica_gate(self, shard: _Shard):
         """Route a shard's replica-ack gate to the writer loop's lanes;

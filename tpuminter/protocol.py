@@ -75,6 +75,23 @@ the coordinator only sends RollAssign to workers that advertised it,
 and a worker only emits Beacons for chunks that ARRIVED as a RollAssign
 (proof the coordinator speaks the dialect). Either side being old
 degrades to classic global-index Assigns with no flag day.
+
+**Federation dialect (ISSUE 18).** An aggregator node speaks this
+protocol in both directions: worker upward (its ``Join`` carries
+``agg=<name>``, the aggregator hello) and coordinator downward to its
+local fleet. Three extensions ride the same no-flag-day rules:
+
+- ``RollAssign.lease_epoch`` / ``Beacon.lease_epoch`` — the lease
+  fencing credential. A chunk whose un-beaconed suffix is re-leased to
+  a sibling (work-stealing) bumps its job's lease epoch; the loser's
+  late Beacons carry the old epoch and are rejected at settle, never
+  double-counted. Epochs travel as NEW binary tags (0xBC/0xBD — v1
+  tags never change meaning) and an omitted-when-zero JSON key, and
+  the coordinator only stamps a non-zero epoch toward peers that sent
+  the aggregator hello, so old workers never see an unknown layout.
+- :class:`Steal` — aggregator → coordinator: "my local fleet is idle;
+  re-lease me the un-beaconed suffix of a slow sibling's assignment".
+  JSON-only (rare by construction).
 """
 
 from __future__ import annotations
@@ -97,6 +114,7 @@ __all__ = [
     "Assign",
     "RollAssign",
     "Beacon",
+    "Steal",
     "Refuse",
     "RepHello",
     "SyncFrom",
@@ -177,6 +195,18 @@ class Join:
     the key is omitted when empty so old decoders ignore it, and the
     coordinator only dispatches a workload job to workers that
     advertised its name.
+
+    ``agg`` is the aggregator hello (ISSUE 18): a non-empty value names
+    a federation aggregator fronting a local fleet — it behaves as a
+    worker on this connection, but the coordinator additionally (a)
+    stamps lease epochs into its RollAssigns (the hello doubles as the
+    lease-epoch capability advertisement; plain workers always see the
+    classic epoch-free layout), (b) accepts :class:`Steal` requests
+    from it, and (c) accounts its dispatches as delegated leases.
+    Same no-flag-day contract: the JSON key is omitted when empty
+    (a Join carrying it encodes as JSON — the v1 binary Join layout
+    predates the field) and an old coordinator ignores it, degrading
+    the aggregator to a plain worker.
     """
 
     backend: str = "cpu"
@@ -185,6 +215,7 @@ class Join:
     codec: str = "json"
     roll: bool = False
     workloads: Tuple[str, ...] = ()
+    agg: str = ""
 
 
 @dataclass(frozen=True)
@@ -366,12 +397,19 @@ class RollAssign:
     but one 33-byte message now covers ``count · 2^nonce_bits`` indices
     instead of a few thousand. Only sent to workers that advertised
     ``Join.roll`` (module docstring); progress inside the chunk flows
-    back via :class:`Beacon`."""
+    back via :class:`Beacon`.
+
+    ``lease_epoch`` is the federation fencing credential (ISSUE 18):
+    the job's lease epoch at dispatch time. It is only ever non-zero
+    toward peers that sent the aggregator hello (``Join.agg``) — a
+    sibling steal bumps the epoch, so the victim's late progress
+    claims carry a stale epoch and are fenced at settle."""
 
     job_id: int
     chunk_id: int
     extranonce0: int
     count: int
+    lease_epoch: int = 0
 
 
 @dataclass(frozen=True)
@@ -392,13 +430,38 @@ class Beacon:
     hedging/eviction sees real straggler progress instead of a silent
     multi-hour chunk. Purely advisory: losing every Beacon degrades to
     pre-beacon behavior, and the final Result still settles the whole
-    remainder."""
+    remainder.
+
+    ``lease_epoch`` echoes the RollAssign's lease epoch (ISSUE 18):
+    the coordinator rejects a Beacon whose epoch no longer matches the
+    chunk's recorded lease — the loser of a sibling steal reports
+    progress on a lease it no longer holds, and accepting it would
+    double-count the stolen suffix."""
 
     job_id: int
     chunk_id: int
     high_water: int
     nonce: int
     hash_value: int
+    lease_epoch: int = 0
+
+
+@dataclass(frozen=True)
+class Steal:
+    """Aggregator → coordinator: my local fleet has idle capacity and
+    nothing queued — re-lease me the un-beaconed suffix of a slow
+    sibling's assignment (ISSUE 18 work-stealing).
+
+    Purely a hint: the coordinator picks the victim (the oldest
+    no-progress rolled chunk with at least one whole un-beaconed
+    segment left, older than its ``steal_after`` threshold) or ignores
+    the request. A successful steal bumps the job's lease epoch before
+    re-dispatching the suffix, so the victim's late Beacons/Results
+    are fenced, not double-counted. ``job_id`` restricts the hunt to
+    one job (0 = any). JSON-only: steals are rare by construction
+    (one per idle episode, rate-limited sender-side)."""
+
+    job_id: int = 0
 
 
 @dataclass(frozen=True)
@@ -505,7 +568,7 @@ class SyncAck:
 
 Message = Union[
     Join, Request, Result, WorkResult, Cancel, Setup, Assign, RollAssign,
-    Beacon, Refuse, RepHello, SyncFrom, WalStart, WalBatch, SyncAck,
+    Beacon, Steal, Refuse, RepHello, SyncFrom, WalStart, WalBatch, SyncAck,
 ]
 
 _KINDS = {
@@ -518,6 +581,7 @@ _KINDS = {
     "assign": Assign,
     "rassign": RollAssign,
     "beacon": Beacon,
+    "steal": Steal,
     "refuse": Refuse,
     "rhello": RepHello,
     "syncfrom": SyncFrom,
@@ -571,6 +635,14 @@ _TAG_BEACON = 0xBA
 #: opaquely; like WalBatch, the trailing envelope CRC carries the
 #: corruption contract and distinct-length aliasing does not apply.
 _TAG_WRESULT = 0xBB
+#: Federation lease-epoch variants (ISSUE 18): a RollAssign/Beacon
+#: carrying a non-zero ``lease_epoch``. NEW tags, not new layouts for
+#: 0xB9/0xBA — v1 tags never change meaning, and only peers that sent
+#: the aggregator hello (``Join.agg``) ever receive/emit them, so an
+#: old peer never meets the unknown tag at all. The epoch is a u64 so
+#: each layout lands on a total length no other fixed-size kind uses.
+_TAG_ASSIGN_ROLL_E = 0xBC
+_TAG_BEACON_E = 0xBD
 
 # Field layouts (little-endian). Every struct is a distinct total size
 # (+4 CRC bytes), so a corrupted tag always fails the length check even
@@ -592,6 +664,12 @@ _BIN_ASSIGN_ROLL = struct.Struct("<BQQQI")   # tag, job, chunk,
 _BIN_BEACON = struct.Struct("<BQQQQ32s")     # tag, job, chunk,
 #                                              high_water, nonce,
 #                                              hash (u256 LE)
+_BIN_ASSIGN_ROLL_E = struct.Struct("<BQQQIQ")  # tag, job, chunk,
+#                                                extranonce0, count,
+#                                                lease_epoch
+_BIN_BEACON_E = struct.Struct("<BQQQQ32sQ")  # tag, job, chunk,
+#                                              high_water, nonce,
+#                                              hash (u256 LE), lease_epoch
 _CRC = struct.Struct("<I")
 
 _BIN_BY_TAG = {
@@ -603,6 +681,8 @@ _BIN_BY_TAG = {
     _TAG_JOIN: _BIN_JOIN,
     _TAG_ASSIGN_ROLL: _BIN_ASSIGN_ROLL,
     _TAG_BEACON: _BIN_BEACON,
+    _TAG_ASSIGN_ROLL_E: _BIN_ASSIGN_ROLL_E,
+    _TAG_BEACON_E: _BIN_BEACON_E,
 }
 
 _JOIN_FLAG_BIN = 0x01   # Join.codec == "bin"
@@ -651,8 +731,14 @@ def _encode_binary(msg: Message) -> Optional[bytes]:
     if isinstance(msg, RollAssign):
         if not (0 <= msg.job_id < _U64 and 0 <= msg.chunk_id < _U64
                 and 0 <= msg.extranonce0 < _U64
-                and 0 < msg.count < (1 << 32)):
+                and 0 < msg.count < (1 << 32)
+                and 0 <= msg.lease_epoch < _U64):
             return None
+        if msg.lease_epoch:
+            return _seal(_BIN_ASSIGN_ROLL_E.pack(
+                _TAG_ASSIGN_ROLL_E, msg.job_id, msg.chunk_id,
+                msg.extranonce0, msg.count, msg.lease_epoch,
+            ))
         return _seal(_BIN_ASSIGN_ROLL.pack(
             _TAG_ASSIGN_ROLL, msg.job_id, msg.chunk_id,
             msg.extranonce0, msg.count,
@@ -660,8 +746,15 @@ def _encode_binary(msg: Message) -> Optional[bytes]:
     if isinstance(msg, Beacon):
         if not (0 <= msg.job_id < _U64 and 0 <= msg.chunk_id < _U64
                 and 0 <= msg.high_water < _U64 and 0 <= msg.nonce < _U64
-                and 0 <= msg.hash_value < _U256):
+                and 0 <= msg.hash_value < _U256
+                and 0 <= msg.lease_epoch < _U64):
             return None
+        if msg.lease_epoch:
+            return _seal(_BIN_BEACON_E.pack(
+                _TAG_BEACON_E, msg.job_id, msg.chunk_id, msg.high_water,
+                msg.nonce, msg.hash_value.to_bytes(32, "little"),
+                msg.lease_epoch,
+            ))
         return _seal(_BIN_BEACON.pack(
             _TAG_BEACON, msg.job_id, msg.chunk_id, msg.high_water,
             msg.nonce, msg.hash_value.to_bytes(32, "little"),
@@ -696,7 +789,8 @@ def _encode_binary(msg: Message) -> Optional[bytes]:
                 or not 0 <= msg.lanes < (1 << 32)
                 or not 0 <= msg.span < _U64
                 or msg.codec not in ("json", "bin")
-                or msg.workloads):  # v1 layout predates the field: JSON
+                or msg.workloads  # v1 layout predates the field: JSON
+                or msg.agg):      # aggregator hello: JSON likewise
             return None
         flags = _JOIN_FLAG_BIN if msg.codec == "bin" else 0
         if msg.roll:
@@ -790,6 +884,15 @@ def _decode_binary(raw) -> Message:
             if count < 1:
                 raise ProtocolError("roll assign must cover >= 1 extranonce")
             return RollAssign(job_id, chunk_id, extranonce0, count)
+        if tag == _TAG_ASSIGN_ROLL_E:
+            _, job_id, chunk_id, extranonce0, count, epoch = (
+                _BIN_ASSIGN_ROLL_E.unpack_from(raw)
+            )
+            if count < 1:
+                raise ProtocolError("roll assign must cover >= 1 extranonce")
+            return RollAssign(
+                job_id, chunk_id, extranonce0, count, lease_epoch=epoch
+            )
         if tag == _TAG_BEACON:
             _, job_id, chunk_id, high_water, nonce, digest = (
                 _BIN_BEACON.unpack_from(raw)
@@ -797,6 +900,14 @@ def _decode_binary(raw) -> Message:
             return Beacon(
                 job_id, chunk_id, high_water, nonce,
                 int.from_bytes(digest, "little"),
+            )
+        if tag == _TAG_BEACON_E:
+            _, job_id, chunk_id, high_water, nonce, digest, epoch = (
+                _BIN_BEACON_E.unpack_from(raw)
+            )
+            return Beacon(
+                job_id, chunk_id, high_water, nonce,
+                int.from_bytes(digest, "little"), lease_epoch=epoch,
             )
         if tag == _TAG_REFUSE:
             _, job_id, chunk_id = _BIN_REFUSE.unpack_from(raw)
@@ -899,6 +1010,8 @@ def encode_msg(msg: Message, *, binary: bool = False) -> bytes:
             obj["roll"] = 1
         if msg.workloads:
             obj["wl"] = list(msg.workloads)
+        if msg.agg:
+            obj["agg"] = msg.agg
     elif isinstance(msg, Request):
         obj = _request_obj(msg)
     elif isinstance(msg, Setup):
@@ -919,6 +1032,8 @@ def encode_msg(msg: Message, *, binary: bool = False) -> bytes:
             "e0": msg.extranonce0,
             "count": msg.count,
         }
+        if msg.lease_epoch:
+            obj["le"] = msg.lease_epoch
     elif isinstance(msg, Beacon):
         obj = {
             "kind": "beacon",
@@ -928,6 +1043,12 @@ def encode_msg(msg: Message, *, binary: bool = False) -> bytes:
             "nonce": msg.nonce,
             "hash": f"{msg.hash_value:x}",
         }
+        if msg.lease_epoch:
+            obj["le"] = msg.lease_epoch
+    elif isinstance(msg, Steal):
+        obj = {"kind": "steal"}
+        if msg.job_id:
+            obj["job_id"] = msg.job_id
     elif isinstance(msg, Refuse):
         obj = {"kind": "refuse", "job_id": msg.job_id, "chunk_id": msg.chunk_id}
         if msg.retry_after_ms:
@@ -1006,6 +1127,7 @@ def decode_msg(raw) -> Message:
                 codec=str(obj.get("codec", "json")),
                 roll=bool(obj.get("roll", 0)),
                 workloads=tuple(str(w) for w in obj.get("wl", [])),
+                agg=str(obj.get("agg", "")),
             )
         if kind == "request":
             return _request_from_obj(obj)
@@ -1030,6 +1152,7 @@ def decode_msg(raw) -> Message:
                 chunk_id=int(obj["chunk_id"]),
                 extranonce0=int(obj["e0"]),
                 count=count,
+                lease_epoch=int(obj.get("le", 0)),
             )
         if kind == "beacon":
             return Beacon(
@@ -1038,7 +1161,10 @@ def decode_msg(raw) -> Message:
                 high_water=int(obj["hw"]),
                 nonce=int(obj["nonce"]),
                 hash_value=int(obj["hash"], 16),
+                lease_epoch=int(obj.get("le", 0)),
             )
+        if kind == "steal":
+            return Steal(job_id=int(obj.get("job_id", 0)))
         if kind == "refuse":
             return Refuse(
                 job_id=int(obj["job_id"]), chunk_id=int(obj["chunk_id"]),

@@ -512,6 +512,44 @@ class ReplicationPrimary:
 # standby side: receive, persist, replay live, promote on demand
 # ---------------------------------------------------------------------------
 
+class _ChainSource:
+    """Duck-typed stand-in for :class:`~tpuminter.journal.Journal` that
+    a CHAIN replication lane tails (ISSUE 18): the same five members a
+    shipping lane reads — ``path``/``size``/``generation``/
+    ``boot_epoch``/``on_batch`` — served from a standby's local WAL
+    copy instead of a live journal. A :class:`ReplicationPrimary`
+    constructed over one re-ships every byte this standby has
+    *persisted* to the next hop, unchanged: same cursor resume, same
+    coalescing, same corruption-is-suffix-loss story. The primary
+    therefore pays for ONE stream no matter how long the chain is —
+    each hop funds the next out of its own disk.
+
+    ``generation`` bumps when the standby full-resyncs (its file was
+    rewritten from 0), which makes the downstream lane restart ITS
+    stream at 0 through the existing compaction-resync path.
+    ``boot_epoch`` relays the epoch this standby follows, so fencing
+    composes down the chain (a promoted mid-chain standby jumps
+    FENCE_JUMP like any promotion and fences its own upstream)."""
+
+    def __init__(self, standby: "ReplicationStandby"):
+        self._standby = standby
+        #: the shipping lane's wake hook (ReplicationPrimary wraps it)
+        self.on_batch: Optional[Callable[[int, bytes], None]] = None
+        self.generation = 0
+
+    @property
+    def path(self) -> str:
+        return self._standby.path
+
+    @property
+    def size(self) -> int:
+        return self._standby.size
+
+    @property
+    def boot_epoch(self) -> int:
+        return self._standby.primary_epoch
+
+
 class ReplicationStandby:
     """The hot standby: an LSP listener that accepts ONE primary's
     shipping stream, persists it to a local WAL copy, and replays every
@@ -541,6 +579,12 @@ class ReplicationStandby:
         #: keys promotion off it
         self.primary_lost = asyncio.Event()
         self.last_contact: Optional[float] = None
+        #: chain replication (ISSUE 18): re-ship every persisted byte to
+        #: the next hop(s). The source duck-types Journal over OUR local
+        #: WAL copy, so the downstream lane is a stock
+        #: ReplicationPrimary — the root primary pays one stream total.
+        self._chain_source = _ChainSource(self)
+        self._chain_lanes: List[ReplicationPrimary] = []
         self.stats = {
             "batches": 0,
             "records_applied": 0,
@@ -562,6 +606,7 @@ class ReplicationStandby:
         host: str = "127.0.0.1",
         params: Optional[Params] = None,
         apply_shadow: bool = True,
+        chain_to: Optional[List[Tuple[str, int]]] = None,
     ) -> "ReplicationStandby":
         """Open (or resume) the local WAL copy at ``wal_path`` — torn
         tail truncated, records replayed into the shadow, cursor
@@ -573,7 +618,14 @@ class ReplicationStandby:
         §Round 10's per-stage decomposition: the standby still scans,
         persists, and acks every batch (the durability half) but skips
         the live shadow replay (the hot-takeover half). Such a sink
-        cannot :meth:`promote`."""
+        cannot :meth:`promote`.
+
+        ``chain_to`` lists next-hop standby addresses: each one gets a
+        chain lane re-shipping this standby's local WAL copy as it
+        grows, so an N-deep replica chain costs the root primary one
+        stream (each hop funds the next). A promoted standby stops its
+        chain lanes — the survivors re-home on the new coordinator's
+        own ``replicate_to`` wiring."""
         self = cls()
         self.path = wal_path
         self._apply_shadow = apply_shadow
@@ -597,6 +649,12 @@ class ReplicationStandby:
                 )
         self._fh = open(wal_path, "ab")
         self._server = await LspServer.create(port, self._params, host=host)
+        for chost, cport in chain_to or []:
+            lane = ReplicationPrimary(
+                self._chain_source, chost, cport, params=self._params
+            )
+            lane.start()
+            self._chain_lanes.append(lane)
         return self
 
     @property
@@ -694,6 +752,12 @@ class ReplicationStandby:
             self._last_start = -1
             self._last_crc = 0
             self.shadow = RecoveredState()
+            # chain lanes must restart THEIR stream at 0 too: the bump
+            # routes them through the same compaction-resync path the
+            # real journal uses
+            self._chain_source.generation += 1
+            if self._chain_source.on_batch is not None:
+                self._chain_source.on_batch(0, b"")
             return
         # a start offset that is neither 0 nor our cursor means the
         # protocol desynced; drop the conn — the redial resyncs cleanly
@@ -735,6 +799,11 @@ class ReplicationStandby:
             self.stats["batches"] += 1
             self.stats["records_applied"] += len(records)
             self.stats["bytes"] += clean
+            # chain replication: wake the next-hop lanes only AFTER the
+            # bytes are persisted locally — a hop never ships data it
+            # could itself lose
+            if self._chain_source.on_batch is not None:
+                self._chain_source.on_batch(msg.offset, blob)
         if clean < len(msg.data):
             # a torn/corrupted shipped batch loses only its suffix —
             # drop the link; the resumed stream re-ships from the clean
@@ -774,6 +843,9 @@ class ReplicationStandby:
         ):
             self._run_task.cancel()
             await asyncio.gather(self._run_task, return_exceptions=True)
+        for lane in self._chain_lanes:
+            await lane.stop()
+        self._chain_lanes = []
         if self._primary_conn is not None:
             self._server.reject_conn(self._primary_conn)
             self._primary_conn = None
@@ -804,6 +876,9 @@ class ReplicationStandby:
         if self._run_task is not None and not self._run_task.done():
             self._run_task.cancel()
             await asyncio.gather(self._run_task, return_exceptions=True)
+        for lane in self._chain_lanes:
+            await lane.stop()
+        self._chain_lanes = []
         if self._fh is not None:
             self._fh.close()
             self._fh = None
@@ -867,13 +942,26 @@ def main(argv: Optional[list] = None) -> None:
         "long (default: follow forever; promotion is an operator "
         "decision)",
     )
+    parser.add_argument(
+        "--chain-to", metavar="HOST:PORT[,...]", default=None,
+        help="chain replication (ISSUE 18): re-ship every persisted "
+        "batch to the next standby hop(s), so the primary pays one "
+        "stream however deep the chain; a promoted standby stops "
+        "chaining (its successor re-targets the new primary)",
+    )
     args = parser.parse_args(argv)
     host, _, port = args.primary.rpartition(":")
+    chain_to = None
+    if args.chain_to:
+        chain_to = []
+        for addr in args.chain_to.split(","):
+            chost, _, cport = addr.strip().rpartition(":")
+            chain_to.append((chost or "127.0.0.1", int(cport)))
     logging.basicConfig(level=logging.INFO)
 
     async def _run() -> None:
         standby = await ReplicationStandby.create(
-            args.wal, port=args.port
+            args.wal, port=args.port, chain_to=chain_to
         )
         log.info(
             "standby listening on port %d, following %s",

@@ -40,6 +40,7 @@ from typing import Any, List, Optional
 
 __all__ = [
     "Fold", "FMin", "TopK", "FirstMatch", "FSum", "seal_payload",
+    "tree_merge",
 ]
 
 _U64 = 1 << 64
@@ -73,6 +74,26 @@ assert _BIN_WTOPK.size == 2 + 16 * TOPK_SLOTS, "slot table out of sync"
 def seal_payload(body: bytes) -> bytes:
     """``body ‖ crc32(body)`` — the chunk-partial frame trailer."""
     return body + _CRC.pack(zlib.crc32(body))
+
+
+def tree_merge(fold: "Fold", groups: List[List[Any]]) -> Any:
+    """Fold a partition of chunk partials group-by-group, then combine
+    the group accumulators — the two-tier composition the federation
+    plane rides (each aggregator folds its fleet's partials into ONE
+    upward result; the parent combines per-aggregator results). Equals
+    the flat fold over the concatenation for every registered
+    discipline, because ``combine`` is associative and commutative;
+    tests/test_federation.py pins that equality under duplicate
+    delivery and replay for the idempotent folds, while FSum's half of
+    exactly-once is the coverage gate (each tier absorbs a given
+    coverage range once, so no partial reaches ``combine`` twice)."""
+    acc = fold.initial()
+    for group in groups:
+        sub = fold.initial()
+        for part in group:
+            sub = fold.combine(sub, part)
+        acc = fold.combine(acc, sub)
+    return acc
 
 
 def _open_payload(data: bytes, layout: struct.Struct, tag: int) -> tuple:
