@@ -133,7 +133,7 @@ from tpuminter.protocol import (  # noqa: E402
 
 
 async def make_coordinator(
-    port: int = 0, *, loops: int = 1, io_batch=None,
+    port: int = 0, *, loops: int = 1, procs: int = 1, io_batch=None,
     journal_mode: str = "writer", recover_from=None,
     threaded: bool = False, **kwargs
 ):
@@ -141,10 +141,23 @@ async def make_coordinator(
     builds the multi-loop sharded group (``tpuminter.multiloop``) — and
     FAILS LOUDLY if it cannot (no silent single-loop fallback: a smoke
     gate that asked for 2 loops must never accidentally measure 1).
+    ``procs >= 2`` builds the multi-PROCESS group instead
+    (``tpuminter.multiproc``, ISSUE 19) — same no-fallback rule, and
+    mutually exclusive with ``loops`` (a shard is either a loop or a
+    process, never both).
     ``threaded=True`` with ``loops=1`` runs the ONE shard off the
     caller's loop too — the A/B baseline that isolates the partitioning
     seam from the cost of the coordinator simply not sharing the
     drivers' loop (PERF.md §Round 11)."""
+    if procs > 1:
+        if loops > 1 or threaded:
+            raise ValueError("procs>1 is exclusive with loops/threaded")
+        from tpuminter.multiproc import MultiProcCoordinator
+
+        return await MultiProcCoordinator.create(
+            port, procs=procs, io_batch=io_batch,
+            recover_from=recover_from, **kwargs
+        )
     if loops <= 1 and not threaded:
         return await Coordinator.create(
             port, io_batch=io_batch, recover_from=recover_from, **kwargs
@@ -1393,6 +1406,315 @@ def crash_check(metrics: dict) -> list:
 
 
 # ---------------------------------------------------------------------------
+# multi-process scenario (ISSUE 19): one OS process per shard
+# ---------------------------------------------------------------------------
+
+async def _dial_shard(port: int, want: int, procs: int, params: Params):
+    """Redial until the client's ephemeral source port hashes to shard
+    ``want`` — the drills need to choose which PROCESS owns the
+    connection. Hash the address the SERVER sees (loopback), not the
+    0.0.0.0 bind address getsockname reports."""
+    from tpuminter.multiloop import shard_of
+
+    for _ in range(128):
+        c = await LspClient.connect("127.0.0.1", port, params)
+        addr = ("127.0.0.1", c._endpoint.local_addr[1])
+        if shard_of(addr, procs) == want:
+            return c
+        await c.close(drain_timeout=0.1)
+    raise RuntimeError(f"could not land a connection on shard {want}")
+
+
+async def _drain_results(client, *, first_timeout: float,
+                         dup_window: float = 2.0) -> list:
+    """Collect every Result on ``client`` until silence: the drills
+    count answers, so the read keeps going for ``dup_window`` after the
+    first one — a duplicate that was going to arrive, arrives."""
+    answers = []
+    timeout = first_timeout
+    try:
+        while True:
+            msg = decode_msg(await asyncio.wait_for(client.read(), timeout))
+            if isinstance(msg, Result):
+                answers.append(msg)
+                timeout = dup_window
+    except asyncio.TimeoutError:
+        pass
+    return answers
+
+
+async def run_multiproc(
+    n_miners: int = 8,
+    n_clients: int = 4,
+    duration: float = 1.5,
+    *,
+    procs: int = 2,
+    chunk_size: int = 1024,
+    chunks_per_job: Optional[int] = None,
+    params: Params = FAST,
+    warmup: float = 0.5,
+    journal_path: Optional[str] = None,
+    quota_burst: int = 6,
+    drills: bool = True,
+) -> dict:
+    """The multi-process drill suite (ISSUE 19): throughput phase under
+    the full fleet, then — with ``drills`` — the two cross-shard
+    correctness gates the issue names, each against a fresh
+    incarnation:
+
+    1. **rebind drill**: a durable job LIVE at kill -9 recovers on its
+       home shard process; the client's re-submit lands on a FOREIGN
+       shard process and must settle exactly once, answered across the
+       seam (registry consult → park → home-shard re-bind → answer
+       frame), never re-mined into a second answer.
+    2. **quota drill**: one tenant ckey alternating submissions across
+       two shard processes gets ONE budget — cumulative-counter gossip
+       keeps total admissions at ``quota_burst`` (±1 for one in-flight
+       gossip datagram), where unshared buckets would admit 2x.
+
+    Unlike :func:`run_load` nothing here can introspect coordinator
+    internals — every shard is another PROCESS — so the ledgers are
+    harness-side (the clients book every Result they see) and the
+    per-shard counters arrive over the supervisor's control channel."""
+    import shutil
+
+    from tpuminter.multiproc import MultiProcCoordinator
+
+    tmpdir = None
+    if journal_path is None and drills:
+        tmpdir = tempfile.mkdtemp(prefix="tpuminter-multiproc-")
+        journal_path = os.path.join(tmpdir, "coordinator.wal")
+
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        cores = os.cpu_count() or 1
+
+    metrics: dict = {
+        "procs": procs, "fleet": n_miners, "clients": n_clients,
+        "cores_available": cores,
+    }
+
+    # -- phase 1: throughput under the full fleet ------------------------
+    coord = await MultiProcCoordinator.create(
+        0, procs=procs, params=params, chunk_size=chunk_size,
+    )
+    if chunks_per_job is None:
+        chunks_per_job = max(8, 4 * n_miners)
+    upper = chunk_size * chunks_per_job - 1
+    counter = {"jobs": 0, "dup_answers": 0}
+    miners = [
+        asyncio.ensure_future(_instant_miner(coord.port, params))
+        for _ in range(n_miners)
+    ]
+    clients = [
+        asyncio.ensure_future(
+            _client_loop(coord.port, params, i, upper, counter)
+        )
+        for i in range(n_clients)
+    ]
+    try:
+        await asyncio.sleep(warmup)
+        before = await coord.stats_all()
+        jobs0, dups0 = counter["jobs"], counter["dup_answers"]
+        t0 = time.monotonic()
+        await asyncio.sleep(duration)
+        dt = time.monotonic() - t0
+        after = await coord.stats_all()
+        # a miner whose task already finished was disconnected mid-run
+        # (the harness-side stand-in for run_load's loss-event hook:
+        # _instant_miner only returns when its connection is lost)
+        metrics["miners_lost"] = sum(1 for m in miners if m.done())
+        metrics["dup_answers"] = counter["dup_answers"] - dups0
+
+        def _results(snap: dict) -> int:
+            st = snap.get("stats", {})
+            return (st.get("results_accepted", 0)
+                    + st.get("results_rejected", 0))
+
+        b = {s["shard"]: s for s in before}
+        shard_results = {
+            s["shard"]: _results(s) - _results(b.get(s["shard"], {}))
+            for s in after
+        }
+        metrics.update({
+            "duration_s": round(dt, 3),
+            "results_per_s": round(sum(shard_results.values()) / dt, 1),
+            "jobs_per_s": round((counter["jobs"] - jobs0) / dt, 2),
+            "steer_kernel": coord.steer_kernel,
+            "shard_results": [shard_results.get(k, 0)
+                              for k in range(procs)],
+            "seam_fwd_in": sum(
+                s.get("seam", {}).get("fwd_in", 0) for s in after
+            ),
+            "shards_replied": len(after),
+        })
+    finally:
+        for t in clients + miners:
+            t.cancel()
+        await asyncio.gather(*clients, *miners, return_exceptions=True)
+        await coord.close()
+
+    if not drills:
+        if tmpdir is not None:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+        return metrics
+
+    # -- phase 2: cross-shard rebind drill (live job through kill -9) ----
+    # procs may be 1 (the A/B baseline): the drill needs two shards to
+    # cross, so it pins the pair (0, 1) only when there are two
+    home, foreign = (0, 1) if procs >= 2 else (0, 0)
+    coord = await MultiProcCoordinator.create(
+        0, procs=procs, params=params, chunk_size=chunk_size,
+        recover_from=journal_path,
+    )
+    req = Request(
+        job_id=11, mode=PowMode.MIN, lower=0, upper=upper,
+        data=b"multiproc-rebind", client_key="multiproc-drill",
+    )
+    c = await _dial_shard(coord.port, home, procs, params)
+    c.write(encode_msg(req))
+    # no miners connected: the job stays LIVE; give the open+bind
+    # records one tick-flush before the kill
+    await asyncio.sleep(0.6)
+    await c.close(drain_timeout=0.1)
+    await coord.crash()
+
+    coord = await MultiProcCoordinator.create(
+        0, procs=procs, params=params, chunk_size=chunk_size,
+        recover_from=journal_path,
+    )
+    miners = [
+        asyncio.ensure_future(_instant_miner(coord.port, params))
+        for _ in range(n_miners)
+    ]
+    try:
+        await asyncio.sleep(warmup)
+        c = await _dial_shard(coord.port, foreign, procs, params)
+        c.write(encode_msg(req))
+        answers = await _drain_results(c, first_timeout=15.0)
+        await c.close(drain_timeout=0.1)
+        snaps = await coord.stats_all()
+        metrics.update({
+            "rebind_settled": len(answers),
+            "rebind_seam_honored": sum(
+                s.get("stats", {}).get("seam_rebinds_honored", 0)
+                for s in snaps
+            ),
+            "rebind_seam_sent": sum(
+                s.get("seam", {}).get("rebinds_sent", 0) for s in snaps
+            ),
+        })
+    finally:
+        for t in miners:
+            t.cancel()
+        await asyncio.gather(*miners, return_exceptions=True)
+        await coord.close()
+
+    # -- phase 3: shared quota drill (one budget across processes) -------
+    if procs >= 2 and quota_burst > 0:
+        coord = await MultiProcCoordinator.create(
+            0, procs=procs, params=params, chunk_size=chunk_size,
+            quota_rate=0.001, quota_burst=quota_burst,
+        )
+        miners = [
+            asyncio.ensure_future(_instant_miner(coord.port, params))
+            for _ in range(n_miners)
+        ]
+        try:
+            await asyncio.sleep(warmup)
+            ca = await _dial_shard(coord.port, 0, procs, params)
+            cb = await _dial_shard(coord.port, 1, procs, params)
+            admitted = refused = 0
+            for i in range(2 * quota_burst):
+                qc = ca if i % 2 == 0 else cb
+                qreq = Request(
+                    job_id=i + 1, mode=PowMode.MIN, lower=0,
+                    upper=chunk_size - 1, data=b"q-%d" % i,
+                    client_key="multiproc-tenant",
+                )
+                qc.write(encode_msg(qreq))
+                while True:
+                    msg = decode_msg(
+                        await asyncio.wait_for(qc.read(), 15.0)
+                    )
+                    if isinstance(msg, Refuse):
+                        refused += 1
+                        break
+                    if (isinstance(msg, Result)
+                            and msg.job_id == qreq.job_id):
+                        admitted += 1
+                        break
+                # one loop tick of headroom so the admission gossip
+                # lands before the next submission flips shards
+                await asyncio.sleep(0.05)
+            await ca.close(drain_timeout=0.1)
+            await cb.close(drain_timeout=0.1)
+            snaps = await coord.stats_all()
+            metrics.update({
+                "quota_burst": quota_burst,
+                "quota_admitted": admitted,
+                "quota_refused": refused,
+                "quota_foreign_debits": sum(
+                    s.get("stats", {}).get("quota_foreign_debits", 0)
+                    for s in snaps
+                ),
+            })
+        finally:
+            for t in miners:
+                t.cancel()
+            await asyncio.gather(*miners, return_exceptions=True)
+            await coord.close()
+
+    if tmpdir is not None:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return metrics
+
+
+def multiproc_check(metrics: dict) -> list:
+    """The multi-process gates (tier-1 shape, like
+    :func:`smoke_check`): throughput with zero loss and zero duplicate
+    answers, every shard process reporting, the rebind drill settling
+    exactly once, and — when the quota drill ran — one shared budget."""
+    bad = []
+    if metrics.get("results_per_s", 0) <= 0:
+        bad.append(f"no results at all: {metrics}")
+    if metrics.get("dup_answers", 0) > 0:
+        bad.append(
+            f"{metrics['dup_answers']} duplicate answer(s) across the "
+            f"shard processes"
+        )
+    if metrics.get("miners_lost", 0) > 0:
+        bad.append(
+            f"{metrics['miners_lost']} miner connection(s) lost on a "
+            f"healthy loopback run"
+        )
+    if metrics.get("shards_replied") != metrics.get("procs"):
+        bad.append(
+            f"only {metrics.get('shards_replied')} of "
+            f"{metrics.get('procs')} shard processes answered stats"
+        )
+    if "rebind_settled" in metrics and metrics["rebind_settled"] != 1:
+        bad.append(
+            f"rebind drill settled {metrics['rebind_settled']} times "
+            f"(want exactly 1)"
+        )
+    if (metrics.get("procs", 0) >= 2
+            and "rebind_seam_honored" in metrics
+            and metrics["rebind_seam_honored"] < 1):
+        bad.append("re-submit never crossed the rebind registry seam")
+    if "quota_admitted" in metrics:
+        burst = metrics.get("quota_burst", 0)
+        if metrics["quota_admitted"] > burst + 1:
+            bad.append(
+                f"shared tenant admitted {metrics['quota_admitted']} "
+                f"jobs across processes (budget {burst}): quota "
+                f"buckets are not shared"
+            )
+    return bad
+
+
+# ---------------------------------------------------------------------------
 # chain-host scenario (ISSUE 18): a replica process hosting a standby chain
 # ---------------------------------------------------------------------------
 
@@ -2103,10 +2425,12 @@ CHAOS_CELLS = (
     "netsplit", "asym_loss", "delay_reorder",
     "fsync_stall", "enospc", "byzantine",
     "fleet_partition", "flapping_link", "slow_loris",
+    "clock_skew",
 )
 #: the tier-1 smoke subset: one partition cell + one byzantine cell +
-#: the slow-loris reaping cell (ISSUE 18 satellite)
-CHAOS_SMOKE_CELLS = ("netsplit", "byzantine", "slow_loris")
+#: the slow-loris reaping cell (ISSUE 18) + the lying-clock cell
+#: (ISSUE 19 satellite)
+CHAOS_SMOKE_CELLS = ("netsplit", "byzantine", "slow_loris", "clock_skew")
 
 
 async def _byzantine_session(
@@ -2320,11 +2644,19 @@ async def _chaos_fleet_cell(
       every epoch, so liveness never trips) plus mute actors that
       handshake and never speak; the read/first-message deadlines must
       reap both while the honest ledger settles exactly once (ISSUE 18)
+    - ``clock_skew`` — the coordinator's OWN clocks lie (ISSUE 19
+      satellite): monotonic rate drifts ±50% per seeded segment and
+      wall time takes ±30 s NTP-style steps, installed mid-burst on the
+      clock seam. Everything downstream of ``_mono``/``_wall`` —
+      token-bucket refill, retry_after accrual, the winners age bound,
+      the UNBOUND reaper — must degrade to DELAYS, never to losses,
+      duplicates, or evictions; healing is the operator fixing the
+      clock, after which the ledger settles on honest time
     """
     import dataclasses
     import shutil
 
-    from tpuminter.chaos import DiskFaultPlan, FaultPlan
+    from tpuminter.chaos import ClockSkewPlan, DiskFaultPlan, FaultPlan
 
     if name == "slow_loris":
         # arm the deadlines the cell exercises: generous next to honest
@@ -2333,11 +2665,22 @@ async def _chaos_fleet_cell(
         params = dataclasses.replace(
             params, read_deadline_epochs=params.epoch_limit + 2
         )
+    coord_kwargs: dict = {}
+    if name == "clock_skew":
+        # arm every time-trusting subsystem the skew will lie to:
+        # per-ckey token buckets (refill + retry_after accrual), a
+        # winners age bound short enough for the wall steps to cross,
+        # and the UNBOUND-residue reaper
+        coord_kwargs = dict(
+            quota_rate=8.0, quota_burst=4, winners_ttl=5.0,
+            unbound_ttl=2.0,
+        )
     tmpdir = tempfile.mkdtemp(prefix="tpuminter-chaos-")
     journal_path = os.path.join(tmpdir, "chaos.wal")
     coord = await make_coordinator(
         params=params, chunk_size=chunk_size, recover_from=journal_path,
         binary_codec=binary, pipeline_depth=pipeline_depth,
+        **coord_kwargs,
     )
     port = coord.port
     serve = asyncio.ensure_future(coord.serve())
@@ -2382,6 +2725,7 @@ async def _chaos_fleet_cell(
         "byzantine": len(byz_behaviors), "clients": n_clients,
     }
     plan = None
+    clock_plan = None
     fault_hold = fault
     try:
         await asyncio.sleep(pre)
@@ -2467,6 +2811,13 @@ async def _chaos_fleet_cell(
                 ep.set_fault_plan(plan)
             metrics["flap_windows"] = windows
             metrics["flap_dark_s"] = round(flap, 3)
+        elif name == "clock_skew":
+            # the same mid-run installation as fault plans on
+            # endpoints, but on the CLOCK seam: from here every
+            # coordinator time-read drifts (mono) and steps (wall)
+            clock_plan = ClockSkewPlan(seed)
+            coord._mono = clock_plan.mono
+            coord._wall = clock_plan.wall
         else:
             raise ValueError(f"unknown chaos cell {name!r}")
         if name == "byzantine":
@@ -2497,6 +2848,14 @@ async def _chaos_fleet_cell(
             # loris kill. Actor-observed drops ride along as a probe.
             metrics["lorises_dropped"] = lost_events["n"]
             metrics["loris_self_observed"] = loris_drops["n"]
+        if clock_plan is not None:
+            # heal = the operator fixed the clock: restore the honest
+            # time sources so the drain settles the ledger on real
+            # time — anything still missing then was truly lost to the
+            # skew window, not merely delayed by a still-lying clock
+            coord._mono = time.monotonic
+            coord._wall = time.time
+            metrics["clock_stats"] = dict(clock_plan.stats)
         if plan is not None:
             metrics["plan_stats"] = dict(plan.stats)
         if coord._journal is not None:
@@ -2530,6 +2889,9 @@ async def _chaos_fleet_cell(
             metrics["submitted"] - metrics["answered"]
         )
         metrics["poisoned_answers"] = ledger.get("poisoned", 0)
+        metrics["retry_after_honored"] = ledger.get(
+            "retry_after_honored", 0
+        )
         st = coord.stats
         metrics["results_rejected"] = (
             st["results_rejected"] - stats0["results_rejected"]
@@ -2832,6 +3194,29 @@ def chaos_check(metrics: dict, params: Params = FAST) -> list:
                 bad.append(
                     pre + "the cell ran with the deadline disarmed — "
                     "it measured nothing"
+                )
+        elif cell == "clock_skew":
+            cs = m.get("clock_stats", {})
+            if cs.get("max_skew_s", 0.0) <= 0.0:
+                bad.append(
+                    pre + "the clock never diverged from true time: "
+                    "the cell measured an honest clock"
+                )
+            if cs.get("segments", 0) < 1:
+                bad.append(
+                    pre + "no drift segment ever elapsed — the skewed "
+                    "clock was installed but never read"
+                )
+            if m.get("retry_after_honored", 0) <= 0:
+                bad.append(
+                    pre + "no Refuse{retry_after_ms} was ever issued/"
+                    "honored: the token-bucket accrual math under skew "
+                    "went unexercised"
+                )
+            if m.get("miners_evicted", 0) > 0:
+                bad.append(
+                    pre + "a lying coordinator clock got an honest "
+                    "miner evicted"
                 )
         elif cell == "flapping_link":
             if m.get("lost_during_flap", 0) > 0:
@@ -3487,7 +3872,7 @@ def main(argv=None) -> int:
         "--scenario",
         choices=(
             "steady", "crash", "failover", "chaos", "zipf", "churn",
-            "rolled", "workload", "chain-host",
+            "rolled", "workload", "chain-host", "multiproc",
         ),
         default="steady",
         help="steady: the sustained-burst benchmark; crash: kill the "
@@ -3582,6 +3967,14 @@ def main(argv=None) -> int:
         "multi-loop, tpuminter.multiloop; 1 = the classic single-loop "
         "coordinator). Requesting N > 1 on a host that cannot shard "
         "FAILS — never a silent single-loop fallback",
+    )
+    parser.add_argument(
+        "--procs", type=int, default=2, metavar="N",
+        help="multiproc scenario: shard PROCESSES to fork "
+        "(tpuminter.multiproc — each shard its own OS process, GIL, "
+        "journal segment, and verifier executor; cross-shard rebind "
+        "registry and shared quota buckets gossip over the seam "
+        "channel)",
     )
     parser.add_argument(
         "--io-batch", choices=("on", "off"), default="on",
@@ -3771,6 +4164,18 @@ def main(argv=None) -> int:
         violations = workload_check(metrics)
         for v in violations:
             print(f"WORKLOAD FAIL: {v}", file=sys.stderr)
+        return 1 if violations else 0
+    if args.scenario == "multiproc":
+        metrics = asyncio.run(run_multiproc(
+            args.miners, args.clients, min(args.duration, 3.0),
+            procs=args.procs, chunk_size=args.chunk_size,
+            journal_path=args.journal,
+        ))
+        print(json.dumps(metrics) if args.json else
+              "\n".join(f"{k}: {v}" for k, v in metrics.items()))
+        violations = multiproc_check(metrics) if args.smoke else []
+        for v in violations:
+            print(f"MULTIPROC FAIL: {v}", file=sys.stderr)
         return 1 if violations else 0
     if args.scenario == "crash":
         if args.smoke and args.loops > 1:

@@ -1,0 +1,39 @@
+"""True-positive fixture for the proc-seam checker: every shortcut the
+multi-process seam (PR 19) forbids, in one file. Never imported —
+parsed by tests/test_analysis.py only."""
+
+import asyncio  # noqa: F401  (makes the fork rule arm)
+import multiprocessing
+
+# module-level mutable that LOOKS like shared state across the boundary
+SHARED_REGISTRY = {"binds": {}}
+
+
+def _nested_target_factory():
+    # nested def: spawn pickles targets by qualified name — this one
+    # cannot be found at unpickle time
+    def shard_body(cfg):
+        return cfg
+
+    return multiprocessing.Process(target=shard_body, args=({},))
+
+
+def spawn_bad_fleet():
+    # lambda target: unpicklable under spawn
+    p1 = multiprocessing.Process(target=lambda: None)
+    # lambda smuggled inside args
+    p2 = multiprocessing.Process(
+        target=print, args=(lambda x: x,),
+    )
+    # module-level mutable passed by name: the child mutates a COPY
+    p3 = multiprocessing.Process(
+        target=print, args=(SHARED_REGISTRY,),
+    )
+    return p1, p2, p3
+
+
+def fork_with_loops():
+    # fork start method in an asyncio-using module: cloned loop/lock
+    # state deadlocks the child
+    ctx = multiprocessing.get_context("fork")
+    return ctx.Process(target=print, args=())

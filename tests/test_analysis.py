@@ -37,6 +37,7 @@ def _fixture_findings(name, checkers):
         bounded_state,
         codec_conformance,
         loop_blocker,
+        proc_seam,
         retrace,
         thread_seam,
     )
@@ -46,6 +47,7 @@ def _fixture_findings(name, checkers):
         "thread-seam": thread_seam,
         "codec-conformance": codec_conformance,
         "bounded-state": bounded_state,
+        "proc-seam": proc_seam,
     }
     for checker in checkers:
         findings.extend(registry[checker].check_module(src))
@@ -329,6 +331,31 @@ def test_bounded_state_catches_unbounded_table():
     assert "self._seeded" not in symbols    # non-empty construction
     assert not any(f.qualname.startswith("Scratch") for f in findings)
     assert all(f.qualname == "Registry.__init__" for f in findings)
+
+
+def test_proc_seam_catches_boundary_violations():
+    """ISSUE 19: every shortcut the process seam forbids — unpicklable
+    spawn targets (lambda and nested def), a lambda smuggled through
+    ``args=``, a module-level mutable passed as if it stayed shared,
+    and the fork start method in an asyncio-using module."""
+    findings = _fixture_findings("proc_seam_bad.py", ["proc-seam"])
+    symbols = {f.symbol for f in findings}
+    assert "target=lambda" in symbols
+    assert "target=shard_body" in symbols        # nested def target
+    assert "args-lambda" in symbols
+    assert "shared-mutable:SHARED_REGISTRY" in symbols
+    assert "fork-start-method" in symbols
+    assert len(findings) == 5, [f.render() for f in findings]
+
+
+def test_proc_seam_quiet_on_the_real_process_seam():
+    """The production multi-process module is the checker's negative
+    control: spawn context, module-level ``_child_main`` target, plain
+    picklable cfg dict — zero findings, with NO allowlist help."""
+    from tpuminter.analysis import proc_seam
+
+    src = parse_module(REPO_ROOT, os.path.join("tpuminter", "multiproc.py"))
+    assert proc_seam.check_module(src) == []
 
 
 def test_bounded_state_covers_the_aggregator_tables():

@@ -328,11 +328,12 @@ def test_byzantine_eviction_requeues_and_job_finishes_exact():
 
 def test_loadgen_chaos_smoke_gate(capsys):
     """The tier-1 chaos gate (ISSUE 12 satellite; slow-loris cell added
-    by ISSUE 18): ``--scenario chaos --smoke`` runs the netsplit +
-    byzantine + slow_loris cells with the full ``chaos_check``
-    assertions behind rc — exactly-once ledger, split brain contained,
-    forged answers contained, offenders evicted, lorises reaped —
-    reproducible from ``--seed``."""
+    by ISSUE 18, clock-skew cell by ISSUE 19): ``--scenario chaos
+    --smoke`` runs the netsplit + byzantine + slow_loris + clock_skew
+    cells with the full ``chaos_check`` assertions behind rc —
+    exactly-once ledger, split brain contained, forged answers
+    contained, offenders evicted, lorises reaped, a lying clock
+    degrading to delays only — reproducible from ``--seed``."""
     import json as _json
 
     rc = loadgen.main([
@@ -343,7 +344,9 @@ def test_loadgen_chaos_smoke_gate(capsys):
     assert rc == 0, f"chaos smoke gate failed: {out}"
     metrics = _json.loads(out.splitlines()[0])
     assert metrics["seed"] == 3
-    assert metrics["cells"] == ["netsplit", "byzantine", "slow_loris"]
+    assert metrics["cells"] == [
+        "netsplit", "byzantine", "slow_loris", "clock_skew",
+    ]
     ns = metrics["results"]["netsplit"]
     # the exactly-once ledger held across the split (chaos_check
     # enforces the same behind rc; re-asserted so a loosened check
@@ -369,6 +372,16 @@ def test_loadgen_chaos_smoke_gate(capsys):
     assert sl["answers_duplicated"] == 0
     assert sl["lorises_dropped"] > 0
     assert sl["deadline_epochs"] > 0
+    cs = metrics["results"]["clock_skew"]
+    assert cs["answered"] > 0
+    assert cs["answers_lost"] == 0
+    assert cs["answers_duplicated"] == 0
+    # the clock REALLY lied (drift segments elapsed and diverged) yet
+    # every consequence was a delay: refusals honored, nobody evicted
+    assert cs["clock_stats"]["segments"] >= 1
+    assert cs["clock_stats"]["max_skew_s"] > 0.0
+    assert cs["retry_after_honored"] > 0
+    assert cs["miners_evicted"] == 0
 
 
 # ---------------------------------------------------------------------------
@@ -434,6 +447,7 @@ def test_winner_trim_never_evicts_unacked_seeded():
         coord._winners = OrderedDict(table)
         coord._winners_cap = cap
         coord._winners_ttl = ttl
+        coord._wall = _time.time  # the clock seam (ISSUE 19)
         coord.stats = {"winners_evicted": 0}
         coord._trim_winners()
 

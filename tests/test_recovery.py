@@ -910,3 +910,78 @@ def test_rolled_job_survives_crash_with_batched_path(tmp_path):
                 await coord.close()
 
     run(scenario(), timeout=150.0)
+
+
+# ---------------------------------------------------------------------------
+# admission state is durable (ISSUE 19 satellite)
+# ---------------------------------------------------------------------------
+
+def test_quota_buckets_survive_crash_recovery(tmp_path):
+    """A tenant's token bucket is part of the recovered state: admit 4
+    of a burst-6 budget, kill -9, restart from the journal — the tenant
+    resumes at ~2 tokens (never a fresh burst: a crash must not be a
+    quota-reset button), its strike count rides along, and an identity
+    the journal never saw still gets the full burst. The refill clock
+    restarting at boot only under-grants (rate here is ~0 anyway)."""
+    from tpuminter.journal import scan_file
+    from tpuminter.protocol import encode_msg
+
+    wal = str(tmp_path / "quota.wal")
+
+    async def scenario():
+        coord = await Coordinator.create(
+            params=FAST, chunk_size=512, recover_from=wal,
+            quota_rate=0.001, quota_burst=6,
+        )
+        port = coord.port
+        serve = asyncio.ensure_future(coord.serve())
+        coord2 = None
+        client = None
+        try:
+            # no miners on purpose: admission happens at submission,
+            # the jobs just queue — this test is about the bucket
+            client = await LspClient.connect("127.0.0.1", port, FAST)
+            for jid in range(1, 5):
+                client.write(encode_msg(Request(
+                    job_id=jid, mode=PowMode.MIN, lower=0, upper=4095,
+                    data=b"quota-%d" % jid, client_key="tenant-q",
+                )))
+            t0 = time.monotonic()
+            while len(coord._jobs) < 4:
+                assert time.monotonic() - t0 < 10, "submissions lost"
+                await asyncio.sleep(0.01)
+            tok, _, strikes = coord._buckets["tenant-q"]
+            assert tok == pytest.approx(2.0, abs=0.01)
+            # flush the dirty bucket the way the rate ticker does, then
+            # hold the crash until the record is REALLY on disk
+            coord._journal_quota()
+            t0 = time.monotonic()
+            while not replay(scan_file(wal)).quota:
+                assert time.monotonic() - t0 < 10, "quota record unwritten"
+                await asyncio.sleep(0.02)
+            # -- kill -9 -------------------------------------------------
+            serve.cancel()
+            await asyncio.gather(serve, return_exceptions=True)
+            endpoint = coord.server.endpoint
+            coord.crash()
+            await endpoint.wait_closed()
+            # -- restart from the journal --------------------------------
+            coord2 = await _restart_coordinator(
+                port, wal, quota_rate=0.001, quota_burst=6
+            )
+            assert "tenant-q" in coord2._buckets, (
+                "the tenant's bucket must survive the crash"
+            )
+            tok2, _, strikes2 = coord2._buckets["tenant-q"]
+            assert tok2 == pytest.approx(tok, abs=0.01)
+            assert strikes2 == strikes
+            assert "tenant-fresh" not in coord2._buckets  # full burst due
+        finally:
+            if client is not None:
+                await client.close(drain_timeout=0.1)
+            serve.cancel()
+            await asyncio.gather(serve, return_exceptions=True)
+            if coord2 is not None:
+                await coord2.close()
+
+    run(scenario(), timeout=60.0)

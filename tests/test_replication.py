@@ -583,3 +583,80 @@ def test_parse_addr_list():
     assert parse_addr_list(":9000") == [("127.0.0.1", 9000)]
     with pytest.raises(ValueError):
         parse_addr_list(",")
+
+
+def test_quota_buckets_survive_failover_promotion(tmp_path):
+    """ISSUE 19 satellite: the ``quota`` record rides the replication
+    WAL stream like every other append, so a promoted standby restores
+    tenant budgets instead of resetting them — losing the primary
+    MACHINE (its journal is never re-read) must not hand every tenant
+    a fresh burst."""
+    from tpuminter.journal import scan_file
+    from tpuminter.lsp import LspClient
+    from tpuminter.protocol import encode_msg
+
+    pwal = str(tmp_path / "p.wal")
+    swal = str(tmp_path / "s.wal")
+
+    async def scenario():
+        standby = await ReplicationStandby.create(swal, params=FAST)
+        runner = asyncio.ensure_future(standby.run())
+        coord = await Coordinator.create(
+            params=FAST, chunk_size=512, recover_from=pwal,
+            replicate_to=[("127.0.0.1", standby.port)], replica_ack=True,
+            quota_rate=0.001, quota_burst=6,
+        )
+        serve = asyncio.ensure_future(coord.serve())
+        coord2 = None
+        client = None
+        try:
+            # no miners: this drill is about the admission ledger, the
+            # submitted jobs just queue in the shadow
+            client = await LspClient.connect(
+                "127.0.0.1", coord.port, FAST
+            )
+            for jid in range(1, 5):
+                client.write(encode_msg(Request(
+                    job_id=jid, mode=PowMode.MIN, lower=0, upper=4095,
+                    data=b"failover-quota-%d" % jid,
+                    client_key="tenant-f",
+                )))
+            t0 = time.monotonic()
+            while len(coord._jobs) < 4:
+                assert time.monotonic() - t0 < 10, "submissions lost"
+                await asyncio.sleep(0.01)
+            tok, _, strikes = coord._buckets["tenant-f"]
+            assert tok == pytest.approx(2.0, abs=0.01)
+            coord._journal_quota()
+            # the record must have SHIPPED (landed in the standby's
+            # local WAL) before the machine dies — machine loss only
+            # forgives the in-flight tail
+            t0 = time.monotonic()
+            while not replay(scan_file(swal)).quota:
+                assert time.monotonic() - t0 < 10, "quota never shipped"
+                await asyncio.sleep(0.02)
+            # -- the primary machine dies, journal and all ---------------
+            await _drain(serve)
+            coord.crash()
+            await asyncio.wait_for(
+                standby.primary_lost.wait(),
+                20 * FAST.epoch_limit * FAST.epoch_seconds,
+            )
+            coord2 = await standby.promote(
+                quota_rate=0.001, quota_burst=6
+            )
+            assert "tenant-f" in coord2._buckets, (
+                "the tenant's bucket must survive into the promotion"
+            )
+            tok2, _, strikes2 = coord2._buckets["tenant-f"]
+            assert tok2 == pytest.approx(tok, abs=0.01)
+            assert strikes2 == strikes
+        finally:
+            if client is not None:
+                await client.close(drain_timeout=0.1)
+            await _drain(runner)
+            await _drain(serve)
+            if coord2 is not None:
+                await coord2.close()
+
+    run(scenario(), timeout=90.0)

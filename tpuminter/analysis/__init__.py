@@ -16,6 +16,10 @@ of recurring, mechanically-detectable classes:
   invariants — distinct total length per tag, CRC on every binary kind,
   u64-guarded fields — re-proved from the struct tables themselves
   instead of only by golden tests.
+- **proc-seam** (PR 19): state that cannot cross the fork/spawn
+  process boundary — lambda/nested ``Process`` targets (unpicklable
+  under spawn), fork start methods in threading/asyncio modules, and
+  module-level mutables passed into a child as if they stayed shared.
 
 The static half lives in the ``*_checker`` submodules and runs via
 ``scripts/check.py`` (and tier-1's ``tests/test_analysis.py``) against

@@ -38,6 +38,7 @@ CHECKERS = (
     "thread-seam",
     "codec-conformance",
     "bounded-state",
+    "proc-seam",
 )
 
 
@@ -242,6 +243,7 @@ def run_project(
         bounded_state,
         codec_conformance,
         loop_blocker,
+        proc_seam,
         retrace,
         thread_seam,
     )
@@ -252,6 +254,7 @@ def run_project(
         "thread-seam": thread_seam,
         "codec-conformance": codec_conformance,
         "bounded-state": bounded_state,
+        "proc-seam": proc_seam,
     }
     selected = checkers or CHECKERS
     modules = [parse_module(root, p) for p in iter_python_files(root, targets)]

@@ -1,0 +1,204 @@
+"""proc-seam: state that cannot (or must not) cross the fork/spawn
+process boundary (PR 19's bug class).
+
+The multi-process coordinator (:mod:`tpuminter.multiproc`) forks one OS
+process per shard. Its memory model is even narrower than the thread
+seam's: NOTHING live crosses the boundary. A child is configured with a
+plain picklable dict of scalars and rebuilds every object (journal,
+server, coordinator, executor) inside its own interpreter; all ongoing
+coordination goes over datagrams. The bug class this checker catches is
+the tempting shortcut that silently breaks that model:
+
+- **unpicklable targets** — ``Process(target=lambda: ...)`` or a
+  ``target=`` naming a *nested* function: the spawn context pickles the
+  target by qualified name, so both fail at start() — but only on the
+  spawn platforms (macOS/Windows/our spawn-everywhere policy), which is
+  exactly how they sneak past a Linux-fork-only test run.
+- **unpicklable args** — a ``lambda`` inside ``args=``/``kwargs=`` of a
+  ``Process(...)`` construction: same failure, harder to spot because
+  the pickle error names the lambda, not the call site.
+- **fork with threads/loops** — ``get_context("fork")`` or
+  ``set_start_method("fork")`` in a module that also touches
+  ``threading`` or ``asyncio``: fork clones lock and loop state
+  mid-flight; the child inherits a possibly-held GIL-adjacent mutex or
+  a registered-but-dead event loop and deadlocks at the first acquire.
+  Every process seam in this codebase is spawn by policy.
+- **shared-mutable illusions** — a module-level dict/list/set literal
+  passed by name in ``args=``: each child receives a pickled COPY, so
+  parent-side mutations silently stop propagating the moment the
+  process starts — state that *looks* shared and isn't. Cross-process
+  state must travel over an IPC channel (the seam socket), not by
+  reference.
+
+Modules that never construct a ``Process`` are not analyzed at all.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from tpuminter.analysis.core import Finding, ModuleSource, dotted, qualname_index
+
+CHECKER = "proc-seam"
+
+#: names whose presence marks a module as multiprocessing-constructing
+_PROCESS_CTORS = {"Process"}
+
+
+def _is_process_ctor(name: str) -> bool:
+    base = name.rsplit(".", 1)[-1]
+    return base in _PROCESS_CTORS
+
+
+class _Facts(ast.NodeVisitor):
+    """One pass for the module-shape facts the rules need."""
+
+    def __init__(self) -> None:
+        #: function name → nesting depth (module-level defs are depth 0)
+        self.def_depth: Dict[str, int] = {}
+        self._depth = 0
+        #: module-level names bound to mutable literals
+        self.module_mutables: Set[str] = set()
+        self.uses_threading = False
+        self.uses_asyncio = False
+        self.process_calls: List[ast.Call] = []
+        self.fork_calls: List[ast.Call] = []
+
+    def _visit_func(self, node) -> None:
+        # record the shallowest depth a name is defined at: a nested
+        # helper shadowing a module-level def of the same name is rare
+        # enough that the benign reading wins
+        prev = self.def_depth.get(node.name)
+        if prev is None or self._depth < prev:
+            self.def_depth[node.name] = self._depth
+        self._depth += 1
+        self.generic_visit(node)
+        self._depth -= 1
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self._depth == 0 and isinstance(
+            node.value, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                         ast.ListComp, ast.SetComp)
+        ):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.module_mutables.add(tgt.id)
+        self.generic_visit(node)
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            root = alias.name.split(".")[0]
+            if root == "threading":
+                self.uses_threading = True
+            elif root == "asyncio":
+                self.uses_asyncio = True
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        root = (node.module or "").split(".")[0]
+        if root == "threading":
+            self.uses_threading = True
+        elif root == "asyncio":
+            self.uses_asyncio = True
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted(node.func)
+        if name is not None:
+            if _is_process_ctor(name):
+                self.process_calls.append(node)
+            base = name.rsplit(".", 1)[-1]
+            if base in ("get_context", "set_start_method"):
+                for arg in node.args:
+                    if (isinstance(arg, ast.Constant)
+                            and arg.value == "fork"):
+                        self.fork_calls.append(node)
+        self.generic_visit(node)
+
+
+def _contains_lambda(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.Lambda) for n in ast.walk(node))
+
+
+def check_module(src: ModuleSource) -> List[Finding]:
+    facts = _Facts()
+    facts.visit(src.tree)
+    if not facts.process_calls and not facts.fork_calls:
+        return []
+    quals = qualname_index(src.tree)
+    findings: List[Finding] = []
+
+    def here(node: ast.AST) -> str:
+        return quals.get(node, "")
+
+    for call in facts.process_calls:
+        for kw in call.keywords:
+            if kw.arg == "target":
+                if isinstance(kw.value, ast.Lambda):
+                    findings.append(Finding(
+                        CHECKER, src.path, kw.value.lineno, here(call),
+                        "target=lambda",
+                        "Process target is a lambda: unpicklable under "
+                        "the spawn start method — it fails at start() "
+                        "on every spawn platform. Use a module-level "
+                        "function.",
+                    ))
+                else:
+                    ref = dotted(kw.value)
+                    if (ref is not None and "." not in ref
+                            and facts.def_depth.get(ref, 0) > 0):
+                        findings.append(Finding(
+                            CHECKER, src.path, kw.value.lineno,
+                            here(call), f"target={ref}",
+                            f"Process target '{ref}' is a nested "
+                            "function: spawn pickles targets by "
+                            "qualified name, so a closure-scoped def "
+                            "fails at start(). Hoist it to module "
+                            "level and pass its state via args.",
+                        ))
+            elif kw.arg in ("args", "kwargs"):
+                if _contains_lambda(kw.value):
+                    findings.append(Finding(
+                        CHECKER, src.path, kw.value.lineno, here(call),
+                        f"{kw.arg}-lambda",
+                        f"lambda inside Process {kw.arg}=: unpicklable "
+                        "under spawn — the start() pickle error will "
+                        "name the lambda, not this call site. Pass "
+                        "plain data and rebuild callables in the "
+                        "child.",
+                    ))
+                if kw.arg == "args" and isinstance(
+                    kw.value, (ast.Tuple, ast.List)
+                ):
+                    for elt in kw.value.elts:
+                        if (isinstance(elt, ast.Name)
+                                and elt.id in facts.module_mutables):
+                            findings.append(Finding(
+                                CHECKER, src.path, elt.lineno,
+                                here(call), f"shared-mutable:{elt.id}",
+                                f"module-level mutable '{elt.id}' "
+                                "passed into a Process: the child gets "
+                                "a pickled COPY, so mutations stop "
+                                "propagating the moment it starts — "
+                                "state that looks shared and is not. "
+                                "Ship updates over an IPC channel "
+                                "instead.",
+                            ))
+
+    if facts.uses_threading or facts.uses_asyncio:
+        what = "threading" if facts.uses_threading else "asyncio"
+        for call in facts.fork_calls:
+            findings.append(Finding(
+                CHECKER, src.path, call.lineno, here(call),
+                "fork-start-method",
+                f"fork start method in a module that uses {what}: fork "
+                "clones locks and event-loop state mid-flight and the "
+                "child deadlocks at the first acquire. Use "
+                'get_context("spawn") — the process-seam policy.',
+            ))
+
+    return findings

@@ -364,3 +364,78 @@ class DiskFaultPlan:
         if self.fsync_stall_s > 0:
             self.stats["stalls"] += 1
             time.sleep(self.fsync_stall_s)
+
+
+class ClockSkewPlan:
+    """Cumulative clock skew, installed on a live coordinator's clock
+    seam (``coord._mono = plan.mono; coord._wall = plan.wall`` — the
+    same mid-run installation as fault plans on endpoints and the
+    journal). Everything that trusts time is downstream of those two
+    callables: ``retry_after_ms`` accrual math, the token-bucket
+    refill, the winners age bound, and the UNBOUND-residue reaper.
+
+    - the monotonic view stays MONOTONIC (that is the OS contract) but
+      its *rate* drifts: each seeded segment runs fast or slow by up to
+      ``drift`` (0.5 = ±50%), modelling NTP slew and a busted TSC. A
+      rate < 1 starves refills; a rate > 1 over-grants and fires TTL
+      reapers early.
+    - the wall view additionally takes seeded forward/backward STEPS of
+      up to ``max_step_s`` (NTP corrections, an operator fixing the
+      clock). A backward step makes wall time earlier than an existing
+      winner's ``ts`` — the age-bound math must tolerate it.
+
+    Deterministic per seed; ``stats`` books the jumps and the maximum
+    cumulative divergence from true time, so a chaos cell can assert
+    the skew actually happened.
+    """
+
+    def __init__(
+        self,
+        seed: int,
+        *,
+        drift: float = 0.5,
+        max_step_s: float = 30.0,
+        segment_s: float = 0.2,
+    ):
+        if not 0.0 <= drift < 1.0:
+            raise ValueError("drift must be in [0, 1)")
+        self._rng = random.Random(seed)
+        self._drift = drift
+        self._max_step = max_step_s
+        self._segment = segment_s
+        now = time.monotonic()
+        self._seg_start = now        # true time the current segment began
+        self._seg_base = now         # skewed time at the segment start
+        self._rate = 1.0 + self._rng.uniform(-drift, drift)
+        self._wall_offset = 0.0
+        self.stats = {"segments": 0, "jumps": 0, "max_skew_s": 0.0}
+
+    def _advance(self) -> float:
+        """Skewed monotonic now; rolls the rate (and maybe steps the
+        wall offset) at each segment boundary."""
+        now = time.monotonic()
+        if now - self._seg_start >= self._segment:
+            self._seg_base += (now - self._seg_start) * self._rate
+            self._seg_start = now
+            self._rate = 1.0 + self._rng.uniform(-self._drift, self._drift)
+            self.stats["segments"] += 1
+            if self._rng.random() < 0.5:
+                # a wall step: forward or back, the monotonic view
+                # (correctly) never sees it
+                self._wall_offset += self._rng.uniform(
+                    -self._max_step, self._max_step
+                )
+                self.stats["jumps"] += 1
+        skewed = self._seg_base + (now - self._seg_start) * self._rate
+        self.stats["max_skew_s"] = max(
+            self.stats["max_skew_s"], abs(skewed - now)
+        )
+        return skewed
+
+    def mono(self) -> float:
+        return self._advance()
+
+    def wall(self) -> float:
+        # ride the same skewed base so wall and monotonic drift
+        # together, then add the step offset only wall clocks suffer
+        return self._advance() + self._wall_offset

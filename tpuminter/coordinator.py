@@ -40,7 +40,7 @@ import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from dataclasses import replace as dc_replace
-from typing import Deque, Dict, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Set, Tuple
 
 from tpuminter import chain
 from tpuminter import workloads
@@ -427,9 +427,35 @@ class Coordinator:
         unbound_ttl: float = 0.0,
         roll_budget: int = 0,
         steal_after: Optional[float] = None,
+        seam=None,
+        clock=None,
     ):
         self._server = server
         self._chunk_size = chunk_size
+        # -- clock seam (ISSUE 19) ------------------------------------
+        #: injected time sources: every admission/TTL/dedup-age decision
+        #: reads these instead of the time module directly, so the
+        #: chaos matrix's clock-skew cell (tpuminter.chaos.ClockSkewPlan)
+        #: can drive cumulative drift through retry_after_ms, the
+        #: residue reapers, and the winners age bound deterministically.
+        #: Dispatch latency measurement stays on the raw clock — it is
+        #: observability, not policy.
+        self._mono = clock.mono if clock is not None else time.monotonic
+        self._wall = clock.wall if clock is not None else time.time
+        # -- cross-process shard seam (ISSUE 19) ----------------------
+        #: injected rebind/quota gossip seam (tpuminter.multiproc
+        #: _ShardSeam): consulted on dedup/bind misses for durable
+        #: re-submits that may belong to a sibling shard PROCESS, and
+        #: notified of binds/admissions so siblings can route and share
+        #: budgets. None (default, and every single-process mode) makes
+        #: every hook a no-op.
+        self._seam = seam
+        #: (ckey, cjid) → [(origin_shard, remote_conn_id)] — foreign
+        #: shards' clients parked on a local live job or not-yet-durable
+        #: winner (the process-boundary twin of _Winner.waiters).
+        #: Drained by the same durability callback; an abandoned job
+        #: drains its entry as a MISS so the origin mints fresh work.
+        self._remote_waiters: Dict[Tuple[str, int], List[Tuple[int, int]]] = {}
         # -- roll-budget chunking (ISSUE 14) --------------------------
         if roll_budget < 0 or roll_budget > 0xFFFFFFFF:
             raise ValueError("roll_budget must be in [0, 2^32-1]")
@@ -502,6 +528,11 @@ class Coordinator:
         self._buckets: "OrderedDict[str, Tuple[float, float, int]]" = (
             OrderedDict()
         )
+        #: durable ckeys whose buckets changed since the last periodic
+        #: quota journal record (ISSUE 19: admission state survives
+        #: failover) — flushed by _rate_ticker, so the journal cost is
+        #: one small record per stats interval, not one per admission
+        self._quota_dirty: Set[str] = set()
         #: (unbound_since, job_id) reap queue, monotone by time — the
         #: amortized-O(1) UNBOUND sweep; drained by _reap_unbound
         self._unbound_q: Deque[Tuple[float, int]] = deque()
@@ -695,6 +726,16 @@ class Coordinator:
             "steals_denied": 0,
             "beacons_fenced": 0,
             "results_fenced": 0,
+            #: multi-process sharding (ISSUE 19): foreign-shard
+            #: re-submits honored by this shard's rebind registry
+            #: (answered from the winners table or parked on the live
+            #: job) vs. registry misses (the origin shard mints fresh
+            #: local work — duplicate effort, never a duplicate answer);
+            #: plus sibling admissions applied to local buckets so a
+            #: ckey sliced across shard processes sees ONE budget
+            "seam_rebinds_honored": 0,
+            "seam_rebind_misses": 0,
+            "quota_foreign_debits": 0,
         }
         # TPUMINTER_LOOP_AFFINITY=1: the coordinator is single-loop by
         # contract (one per shard in multiloop); any mutation arriving
@@ -731,6 +772,8 @@ class Coordinator:
         unbound_ttl: float = 0.0,
         roll_budget: int = 0,
         steal_after: Optional[float] = None,
+        seam=None,
+        clock=None,
     ) -> "Coordinator":
         """``recover_from`` names a write-ahead journal file
         (``tpuminter.journal``): if it exists its records are replayed —
@@ -765,6 +808,7 @@ class Coordinator:
             retry_after_ms=retry_after_ms, winners_cap=winners_cap,
             winners_ttl=winners_ttl, unbound_ttl=unbound_ttl,
             roll_budget=roll_budget, steal_after=steal_after,
+            seam=seam, clock=clock,
         )
         if recovered is not None:
             coord._adopt(recovered)
@@ -791,7 +835,7 @@ class Coordinator:
             phase = self._next_job_id % stride
             nxt = recovered.next_job_id
             self._next_job_id = nxt + (phase - nxt % stride) % stride
-        now_wall = time.time()
+        now_wall = self._wall()
         for (ckey, cjid), rec in recovered.winners.items():
             ts = float(rec.get("ts", now_wall))
             if self._winners_ttl and now_wall - ts > self._winners_ttl:
@@ -849,7 +893,7 @@ class Coordinator:
                 # re-submits: enroll it in the residue reaper so a
                 # crash mid-churn replays to the same bounded state
                 # (orphans whose clients never return are still reaped)
-                job.unbound_since = time.monotonic()
+                job.unbound_since = self._mono()
                 self._unbound_q.append((job.unbound_since, job.job_id))
             if rjob.client_key:
                 self._bound[(rjob.client_key, rjob.client_job_id)] = (
@@ -876,6 +920,22 @@ class Coordinator:
                 # fully settled pre-crash, finish record lost
                 finish_now.append((job, None))
         self.recovered_leases.update(recovered.leases)
+        if recovered.quota:
+            # admission state survives the crash/failover (ISSUE 19):
+            # tenants resume their recorded balances instead of a fresh
+            # burst each. The refill clock restarts NOW — accrual while
+            # we were down is forfeited, which only under-grants.
+            now_mono = self._mono()
+            for ck, rec_bucket in recovered.quota.items():
+                tok, strikes = float(rec_bucket[0]), int(rec_bucket[1])
+                tier = self._tier(ck)
+                burst = max(1.0, self._quota_burst * tier)
+                self._buckets[ck] = (
+                    min(burst, tok), now_mono, strikes
+                )
+            while len(self._buckets) > QUOTA_BUCKETS_CAP:
+                self._buckets.popitem(last=False)
+            self._hw("quota_buckets_high_water", len(self._buckets))
         if recovered.jobs:
             log.info(
                 "recovered %d live job(s) and %d acknowledged winner(s) "
@@ -933,6 +993,27 @@ class Coordinator:
                 % (job.job_id, lo, hi, msg.hash_value, msg.nonce, searched)
             )
 
+    def _journal_quota(self) -> None:
+        """Flush dirty durable-ckey buckets as one ``quota`` record
+        (ISSUE 19: admission state survives failover — the record rides
+        the replication WAL stream like every other append, so a
+        promoted standby restores tenant budgets instead of resetting
+        them). Anonymous ``@conn:`` buckets die with their session and
+        never reach disk. Refill timestamps are monotonic-local and do
+        not cross the journal; the restorer restarts the refill clock,
+        which only ever under-grants."""
+        if self._journal is None or not self._quota_dirty:
+            self._quota_dirty.clear()
+            return
+        buckets = []
+        for ck in self._quota_dirty:
+            b = self._buckets.get(ck)
+            if b is not None and not ck.startswith("@conn:"):
+                buckets.append([ck, round(b[0], 3), b[2]])
+        self._quota_dirty.clear()
+        if buckets:
+            self._journal_append("quota", {"buckets": buckets})
+
     def _journal_snapshot(self) -> dict:
         """Compacting checkpoint (``Journal.snapshot_provider``): the
         replay-equivalent of the live scheduler state. Remaining
@@ -963,7 +1044,7 @@ class Coordinator:
                 # fold exactly where the settles left it
                 rec["wst"] = job.wstate
             jobs.append(rec)
-        return {
+        snap = {
             "k": "snapshot",
             "next": self._next_job_id,
             "jobs": jobs,
@@ -972,6 +1053,16 @@ class Coordinator:
                 for (ck, cj), w in self._winners.items()
             ],
         }
+        quota = [
+            [ck, round(tok, 3), strikes]
+            for ck, (tok, _last, strikes) in self._buckets.items()
+            if not ck.startswith("@conn:")
+        ]
+        if quota:
+            # gated on presence like the leases list: quota-free
+            # checkpoints keep their exact historical shape
+            snap["quota"] = quota
+        return snap
 
     @staticmethod
     def _winner_rec(ck: str, cj: int, w: "_Winner") -> dict:
@@ -1168,6 +1259,9 @@ class Coordinator:
             # UNBOUND-residue reaper (ISSUE 13)
             self._reap_unbound()
             self._trim_winners()
+            # admission-state durability rides the same cadence (one
+            # small record per interval, ISSUE 19)
+            self._journal_quota()
             cur = self.stats["hashes"]
             if self._rotation and not self._miners:
                 # queued work and NOBODY to mine it. On a single-loop
@@ -1391,7 +1485,7 @@ class Coordinator:
                     # winners table (exactly-once across the redial)
                     job.client_conn = UNBOUND
                     if self._unbound_ttl:
-                        job.unbound_since = time.monotonic()
+                        job.unbound_since = self._mono()
                         self._unbound_q.append(
                             (job.unbound_since, job.job_id)
                         )
@@ -1436,17 +1530,27 @@ class Coordinator:
         tier = self._tier(ckey)
         rate = self._quota_rate * tier
         burst = max(1.0, self._quota_burst * tier)
-        now = time.monotonic()
+        now = self._mono()
         bucket = self._buckets.pop(ckey, None)
         if bucket is None:
             tokens, strikes = burst, 0
         else:
             tokens, last, strikes = bucket
-            tokens = min(burst, tokens + (now - last) * rate)
+            # a skewed/stepped clock can read EARLIER than a bucket's
+            # last refill (the clock-skew chaos cell forces it; a real
+            # suspend/resume can too): clamp the elapsed time at zero
+            # or the negative refill would silently DRAIN the bucket
+            tokens = min(burst, tokens + max(0.0, now - last) * rate)
         if tokens >= 1.0:
             tokens -= 1.0
             ms = 0
             strikes = 0
+            if msg.client_key:
+                self._quota_dirty.add(ckey)
+                if self._seam is not None:
+                    # shared budgets across shard processes: siblings
+                    # debit their replica of this ckey's bucket
+                    self._seam.on_admit(ckey)
         else:
             # exact accrual time for the missing fraction of a token,
             # escalated exponentially while the client keeps hammering:
@@ -1532,7 +1636,7 @@ class Coordinator:
             del self._winners[key]
             evicted += 1
         if self._winners_ttl:
-            cutoff = time.time() - self._winners_ttl
+            cutoff = self._wall() - self._winners_ttl
             for key in evictable[max(0, excess):]:
                 w = self._winners.get(key)
                 if w is not None and w.ts <= cutoff:
@@ -1549,7 +1653,7 @@ class Coordinator:
         (work re-done, never a duplicate answer)."""
         if not self._unbound_ttl:
             return
-        now = time.monotonic()
+        now = self._mono()
         while (
             self._unbound_q
             and now - self._unbound_q[0][0] >= self._unbound_ttl
@@ -1605,6 +1709,14 @@ class Coordinator:
                     # of mining a duplicate
                     self._rebind_job(job, conn_id)
                     return
+            if self._seam is not None and self._seam.consult(conn_id, msg):
+                # cross-process rebind (ISSUE 19): the registry says a
+                # sibling shard owns this (ckey, cjid) — the seam parked
+                # the submission and is asking the home shard; the
+                # answer (or a miss, re-entering here) arrives via the
+                # seam channel. Nothing is minted locally yet, so
+                # exactly-once holds across the process boundary.
+                return
         self._reap_unbound()
         retry_ms = self._admit(conn_id, msg)
         if retry_ms:
@@ -1648,6 +1760,10 @@ class Coordinator:
         self._hw("sessions_high_water", len(self._clients))
         if msg.client_key:
             self._bound[(msg.client_key, msg.job_id)] = job_id
+            if self._seam is not None:
+                # gossip the bind so a post-crash re-submit landing on
+                # a sibling shard re-binds here instead of re-mining
+                self._seam.on_bind(msg.client_key, msg.job_id)
         self._rotation.append(job_id)
         # the job record doubles as the client-bound record: the
         # request carries the durable client_key
@@ -1675,6 +1791,73 @@ class Coordinator:
         log.info(
             "client %d re-bound to running job %d", conn_id, job.job_id
         )
+
+    # -- cross-process shard seam (ISSUE 19) -----------------------------
+
+    def seam_rebind(
+        self, ckey: str, cjid: int, origin: int, remote_conn: int
+    ):
+        """Home-shard half of the cross-process rebind registry: a
+        durable client re-submitted ``(ckey, cjid)`` on shard
+        ``origin``, whose registry names us the owner. Returns the
+        encoded durable winner (answer NOW over the seam), ``True``
+        after parking the foreign client on the live job or in-flight
+        winner (the durability callback answers later), or ``None`` on
+        a miss — the entry was stale; the origin mints fresh local
+        work."""
+        wkey = (ckey, cjid)
+        winner = self._winners.get(wkey)
+        if winner is not None:
+            self.stats["seam_rebinds_honored"] += 1
+            if winner.durable:
+                return encode_msg(winner.result)
+            # finish record still in flight to disk: the foreign client
+            # parks exactly like a local re-submitter would
+            self._remote_waiters.setdefault(wkey, []).append(
+                (origin, remote_conn)
+            )
+            return True
+        bound = self._bound.get(wkey)
+        if bound is not None:
+            job = self._jobs.get(bound)
+            if job is not None and not job.done:
+                self.stats["seam_rebinds_honored"] += 1
+                # someone is waiting again: out of the residue reaper
+                # (same rule as a local re-bind)
+                job.unbound_since = 0.0
+                self._remote_waiters.setdefault(wkey, []).append(
+                    (origin, remote_conn)
+                )
+                return True
+        self.stats["seam_rebind_misses"] += 1
+        return None
+
+    def seam_quota_debit(self, ckey: str, delta: float) -> None:
+        """Apply ``delta`` admissions a sibling shard granted to
+        ``ckey`` against the local bucket replica, so a tenant sliced
+        across shard processes spends ONE budget, not N. Refill to now
+        first (the debit must not eat accrual), then debit, floored at
+        ``-burst`` — gossip duplication or a thundering sibling can
+        only dig a bounded hole."""
+        if self._quota_rate <= 0 or delta <= 0:
+            return
+        tier = self._tier(ckey)
+        rate = self._quota_rate * tier
+        burst = max(1.0, self._quota_burst * tier)
+        now = self._mono()
+        bucket = self._buckets.pop(ckey, None)
+        if bucket is None:
+            tokens, strikes = burst, 0
+        else:
+            tokens, last, strikes = bucket
+            tokens = min(burst, tokens + max(0.0, now - last) * rate)
+        tokens = max(-burst, tokens - delta)
+        self._buckets[ckey] = (tokens, now, strikes)
+        while len(self._buckets) > QUOTA_BUCKETS_CAP:
+            self._buckets.popitem(last=False)
+        self._hw("quota_buckets_high_water", len(self._buckets))
+        self.stats["quota_foreign_debits"] += 1
+        self._quota_dirty.add(ckey)
 
     def _on_result(self, conn_id: int, msg: Result) -> None:
         miner = self._miners.get(conn_id)
@@ -2416,12 +2599,14 @@ class Coordinator:
                 found, searched=job.hashes_done,
             )
         ckey = job.request.client_key
+        wkey = (ckey, job.client_job_id) if ckey else None
         winner: Optional[_Winner] = None
         if ckey:
-            key = (ckey, job.client_job_id)
-            self._winners.pop(key, None)
-            winner = _Winner(result, durable=self._journal is None)
-            self._winners[key] = winner
+            self._winners.pop(wkey, None)
+            winner = _Winner(
+                result, durable=self._journal is None, ts=self._wall()
+            )
+            self._winners[wkey] = winner
             self._hw("winners_high_water", len(self._winners))
             self._trim_winners()
         client_conn = job.client_conn
@@ -2433,7 +2618,7 @@ class Coordinator:
             # and a re-submitter racing the flush parks in
             # winner.waiters until this callback fires.
             on_durable = functools.partial(
-                self._finish_durable, client_conn, result, winner
+                self._finish_durable, client_conn, result, winner, wkey
             )
             if self._replica_ack:
                 # replica-acked tier: on top of the local fsync, hold
@@ -2454,7 +2639,7 @@ class Coordinator:
                 # bound must survive replay (winner is None when
                 # the job has no ckey — then nothing entered the
                 # table and the ts is moot)
-                "ts": winner.ts if winner is not None else time.time(),
+                "ts": winner.ts if winner is not None else self._wall(),
             }
             if job.discipline is not None:
                 rec["wid"] = workloads.get(job.workload).wid
@@ -2462,6 +2647,7 @@ class Coordinator:
             self._journal.append("finish", rec, on_durable=on_durable)
         else:
             self._deliver_finish(client_conn, result)
+            self._drain_remote_waiters(wkey, result)
         elapsed = time.monotonic() - job.started
         rate = job.hashes_done / elapsed if elapsed > 0 else 0.0
         log.info(
@@ -2496,10 +2682,11 @@ class Coordinator:
 
     def _finish_durable(
         self, client_conn: int, result: Result,
-        winner: Optional[_Winner],
+        winner: Optional[_Winner], wkey: Optional[Tuple[str, int]] = None,
     ) -> None:
         """The finish record reached disk: release the answer — to the
-        owning client and to any re-submitter that raced the flush."""
+        owning client, to any re-submitter that raced the flush, and to
+        any foreign shard process whose client is parked on us."""
         if winner is not None:
             winner.durable = True
             waiters, winner.waiters = winner.waiters, []
@@ -2509,6 +2696,25 @@ class Coordinator:
         for conn_id in waiters:
             if conn_id != client_conn:
                 self._deliver_finish(conn_id, result)
+        self._drain_remote_waiters(wkey, result)
+
+    def _drain_remote_waiters(
+        self, wkey: Optional[Tuple[str, int]], result: Optional[Result]
+    ) -> None:
+        """Answer every foreign-shard client parked on ``wkey`` — with
+        the durable Result, or (``result=None``, the abandon path) with
+        a MISS so the origin shard mints fresh local work (duplicate
+        effort, never a duplicate answer)."""
+        if wkey is None:
+            return
+        parked = self._remote_waiters.pop(wkey, None)
+        if not parked or self._seam is None:
+            return
+        payload = b"" if result is None else encode_msg(result)
+        for origin, remote_conn in parked:
+            self._seam.answer_remote(
+                origin, remote_conn, wkey[1], payload, miss=result is None
+            )
 
     def _deliver_finish(self, client_conn: int, result: Result) -> None:
         """Send a finished job's Result to its client (directly, or as
@@ -2575,9 +2781,13 @@ class Coordinator:
             pass
         self._jobs.pop(job.job_id, None)
         if job.request.client_key:
-            self._bound.pop(
-                (job.request.client_key, job.client_job_id), None
-            )
+            wkey = (job.request.client_key, job.client_job_id)
+            self._bound.pop(wkey, None)
+            if wkey not in self._winners:
+                # retired with NO winner (abandoned/shed/reaped): any
+                # foreign shard's client parked here gets a MISS so its
+                # origin re-mines locally instead of waiting forever
+                self._drain_remote_waiters(wkey, None)
         client_jobs = self._clients.get(job.client_conn)
         if client_jobs is not None:
             client_jobs.discard(job.job_id)
@@ -2966,6 +3176,19 @@ def main(argv: Optional[list] = None) -> None:
         "that cannot shard is an ERROR, never a silent fallback",
     )
     parser.add_argument(
+        "--procs", type=int, default=1, metavar="N",
+        help="shard the coordinator across N OS PROCESSES, one "
+        "SO_REUSEPORT socket + private WAL segment + verifier "
+        "executor each (tpuminter.multiproc) — the scale-out past the "
+        "GIL that --loops cannot reach. Shards keep exactly-once "
+        "across the boundary over a local datagram seam: a cross-"
+        "shard rebind registry (a re-submitted in-flight job is "
+        "answered by its home shard, never re-mined) and gossiped "
+        "per-tenant quota buckets (one fleet-wide budget). Default 1; "
+        "exclusive with --loops; N > 1 where SO_REUSEPORT is missing "
+        "is an ERROR, never a silent fallback",
+    )
+    parser.add_argument(
         "--io-batch", choices=("on", "off"), default="on",
         help="batched socket I/O: drain a bounded recvfrom burst per "
         "epoll wakeup and group each tick's sends (default on; off = "
@@ -3082,6 +3305,78 @@ def main(argv: Optional[list] = None) -> None:
             retry_after_ms=args.retry_after_ms,
             winners_ttl=args.winners_ttl, unbound_ttl=args.unbound_ttl,
         )
+        if args.procs > 1:
+            if args.loops > 1:
+                parser.error("--procs and --loops are mutually exclusive")
+            if args.replicate_to:
+                parser.error(
+                    "--replicate-to is not available with --procs yet "
+                    "(per-shard segments have no single shipping stream)"
+                )
+            if (args.hedge_after is not None or args.audit_rate
+                    or args.steal_after is not None):
+                parser.error(
+                    "--hedge-after/--audit-rate/--steal-after are not "
+                    "plumbed through --procs yet"
+                )
+            from tpuminter.multiproc import MultiProcCoordinator
+
+            coord = await MultiProcCoordinator.create(
+                args.port, procs=args.procs,
+                chunk_size=args.chunk_size,
+                stats_interval=args.stats_interval,
+                recover_from=args.journal,
+                pipeline_depth=args.pipeline_depth,
+                binary_codec=args.codec == "binary",
+                io_batch=args.io_batch == "on",
+                roll_budget=args.roll_budget,
+                **admission,
+            )
+            log.info(
+                "coordinator listening on port %d (%d shard processes)",
+                coord.port, args.procs,
+            )
+            if args.stats_port is not None:
+                log.warning(
+                    "--stats-port is not available with --procs; "
+                    "SIGUSR1 dumps the per-shard stats instead"
+                )
+            import signal
+
+            async def _dump_proc_stats() -> None:
+                log.info(
+                    "stats: %s", json.dumps(await coord.stats_all())
+                )
+
+            loop = asyncio.get_running_loop()
+            loop.add_signal_handler(
+                signal.SIGUSR1,
+                lambda: asyncio.ensure_future(_dump_proc_stats()),
+            )
+            # SIGTERM/SIGINT must run the graceful group stop: the
+            # parent dying uncleanly would orphan the shard processes
+            # (they own the port and the WAL segments)
+            stop = asyncio.Event()
+            for signum in (signal.SIGINT, signal.SIGTERM):
+                loop.add_signal_handler(signum, stop.set)
+            try:
+                # the parent only supervises: children own the serve
+                # path. A dead shard takes the group down LOUDLY — a
+                # silently smaller fleet would re-hash nothing (peers
+                # are steered by conn id) and strand its shard's peers.
+                while all(coord.alive()) and not stop.is_set():
+                    try:
+                        await asyncio.wait_for(stop.wait(), 1.0)
+                    except asyncio.TimeoutError:
+                        pass
+                if not stop.is_set():
+                    log.error(
+                        "shard process died (alive=%s); stopping the "
+                        "group", coord.alive(),
+                    )
+            finally:
+                await coord.close()
+            return
         if args.loops > 1:
             from tpuminter.multiloop import MultiLoopCoordinator
 

@@ -432,6 +432,13 @@ class RecoveredState:
     #: tpuminter.federation.lease for the typed view). Empty for every
     #: non-aggregator journal.
     leases: Dict[int, dict] = field(default_factory=dict)
+    #: admission state (ISSUE 19): durable-ckey token buckets,
+    #: ckey → [tokens, strikes]. Journaled so a promoted standby (or a
+    #: crash restart) does not reset every tenant to a fresh budget.
+    #: Refill timestamps are monotonic-clock local and never cross the
+    #: journal — the restorer restarts the refill clock at adopt time,
+    #: which only ever UNDER-grants (conservative).
+    quota: Dict[str, list] = field(default_factory=dict)
     records: int = 0
     #: size bound applied to ``winners`` while folding records (ISSUE
     #: 13: cap-aware replay — a coordinator running a smaller dedup
@@ -461,6 +468,10 @@ class RecoveredState:
             self.finished = set()
             self.leases = {
                 int(l["pc"]): dict(l) for l in rec.get("leases", [])
+            }
+            self.quota = {
+                str(ck): [float(tok), int(strikes)]
+                for ck, tok, strikes in rec.get("quota", [])
             }
         elif k == "job":
             job_id = int(rec["id"])
@@ -521,6 +532,12 @@ class RecoveredState:
             }
         elif k == "lease_end":
             self.leases.pop(int(rec.get("pc", 0)), None)
+        elif k == "quota":
+            # admission state (ISSUE 19): periodic dirty-bucket flush;
+            # latest record wins per ckey (tokens only ever move toward
+            # the truth — the ticker writes post-refill balances)
+            for ck, tok, strikes in rec.get("buckets", []):
+                self.quota[str(ck)] = [float(tok), int(strikes)]
         # assign / requeue / bind: observability records; coverage is
         # derived from settles (every un-settled range re-mines anyway)
 
@@ -540,6 +557,13 @@ class RecoveredState:
             # keep their exact historical shape (old journals replay
             # new snapshots and vice versa)
             obj["leases"] = list(self.leases.values())
+        if self.quota:
+            # same gating: quota-free snapshots keep their historical
+            # shape byte-for-byte
+            obj["quota"] = [
+                [ck, tok, strikes]
+                for ck, (tok, strikes) in self.quota.items()
+            ]
         return obj
 
 
@@ -598,6 +622,15 @@ def merge_states(states: List[RecoveredState]) -> RecoveredState:
         out.records += st.records
         out.finished |= st.finished
         out.leases.update(st.leases)
+        for ck, (tok, strikes) in st.quota.items():
+            cur = out.quota.get(ck)
+            if cur is None:
+                out.quota[ck] = [tok, strikes]
+            else:
+                # conservative union: a tenant sliced across segments
+                # gets the emptiest recorded bucket and the worst strike
+                # count — under-granting is always safe
+                out.quota[ck] = [min(cur[0], tok), max(cur[1], strikes)]
         for jid, job in st.jobs.items():
             cur = out.jobs.get(jid)
             if cur is None:

@@ -72,17 +72,23 @@ table is replicated into EVERY shard, so a durable client re-submitting
 an answered request is answered exactly-once no matter which shard its
 new connection hashes to.
 
-Known, accepted waste in that seam: an IN-FLIGHT (un-answered) job's
-``_bound`` entry lives only on its home shard, and the re-submitting
-client redials from a fresh ephemeral port — with probability
-(N−1)/N it hashes to a different shard, which starts a fresh job over
-the full range while the recovered UNBOUND copy re-mines to exhaustion
-at home. Exactly-once is untouched (the fresh job answers the client;
-the home copy's winner parks undelivered in the dedup table, pinned by
-the --loops crash drills) — the cost is one duplicate job's work per
-in-flight-at-crash durable client whose redial re-hashed. A cross-shard
-rebind registry could close it; deliberately out of scope while the
-seam stays thin (ROADMAP).
+Known, accepted waste in THIS (in-process) seam: an IN-FLIGHT
+(un-answered) job's ``_bound`` entry lives only on its home shard, and
+the re-submitting client redials from a fresh ephemeral port — with
+probability (N−1)/N it hashes to a different shard, which starts a
+fresh job over the full range while the recovered UNBOUND copy re-mines
+to exhaustion at home. Exactly-once is untouched (the fresh job answers
+the client; the home copy's winner parks undelivered in the dedup
+table, pinned by the --loops crash drills) — the cost is one duplicate
+job's work per in-flight-at-crash durable client whose redial
+re-hashed. The multi-PROCESS seam (:mod:`tpuminter.multiproc`,
+ISSUE 19) closes exactly this: shards gossip their ``_bound`` keys into
+a cross-shard rebind registry, a foreign re-submit parks while a REBIND
+frame consults the home shard, and the home copy's answer crosses the
+seam to the parked client — one job, one answer, no duplicate mining.
+This in-process mode deliberately keeps the thin seam and the known
+waste: it has no datagram channel between shards to gossip over, and
+growing one here would duplicate the process seam's machinery.
 """
 
 from __future__ import annotations

@@ -929,6 +929,189 @@ def _decode_binary(raw) -> Message:
         raise ProtocolError(f"malformed binary message: {exc}") from exc
 
 
+# ---------------------------------------------------------------------------
+# cross-process shard seam frames (tpuminter.multiproc, ISSUE 19)
+# ---------------------------------------------------------------------------
+# These never ride the client/worker UDP port: they cross the per-host
+# UNIX datagram channel between shard PROCESSES (and the supervisor).
+# They share the process-wide '{'-disjoint tag namespace so a seam
+# frame can never be mistaken for an app message, a journal record, or
+# a fold payload; 0xD1+ is the block the workload registry left free.
+# All five are VARIABLE-length kinds (ckey / raw datagram / encoded
+# Result payloads follow the head), so like WalBatch the trailing CRC32
+# alone carries the corruption contract.
+_TAG_SEAM_FWD = 0xD1     # mis-steered datagram handoff (CONNECTs land
+#                          on shard 0; the shard_of owner replays them
+#                          through its own socket)
+_TAG_SEAM_BIND = 0xD2    # rebind-registry gossip: shard k owns (ckey,
+#                          client_job_id)
+_TAG_SEAM_REBIND = 0xD3  # foreign shard -> home shard: a durable
+#                          client re-submitted here; re-bind, don't
+#                          duplicate the work
+_TAG_SEAM_ANSWER = 0xD4  # home shard -> foreign shard: the durable
+#                          winner's encoded Result (or a miss, payload
+#                          empty + flag set: mint a fresh local job)
+_TAG_SEAM_QUOTA = 0xD5   # shared admission state: cumulative per-ckey
+#                          admission count gossip (idempotent under
+#                          loss/reorder — receivers apply max-monotonic
+#                          deltas)
+
+_BIN_SEAM_FWD_HEAD = struct.Struct("<B4sH")     # tag, ip4, port
+#                                                 (raw datagram follows)
+_BIN_SEAM_BIND_HEAD = struct.Struct("<BBQ")     # tag, origin shard,
+#                                                 client_job_id
+#                                                 (ckey utf8 follows)
+_BIN_SEAM_REBIND_HEAD = struct.Struct("<BBIQ")  # tag, origin shard,
+#                                                 conn_id, client_job_id
+#                                                 (ckey utf8 follows)
+_BIN_SEAM_ANSWER_HEAD = struct.Struct("<BBIQ")  # tag, flags (bit0 =
+#                                                 miss), conn_id,
+#                                                 client_job_id
+#                                                 (encoded Result follows)
+_BIN_SEAM_QUOTA_HEAD = struct.Struct("<BBQ")    # tag, origin shard,
+#                                                 cumulative admitted
+#                                                 (ckey utf8 follows)
+
+_SEAM_ANSWER_MISS = 0x01
+
+#: ckeys longer than this never cross the seam (the coordinator's own
+#: tables have no such bound, but a seam frame is one datagram and the
+#: registry is a hint — an oversized key just stays shard-local).
+SEAM_CKEY_MAX = 512
+
+
+def encode_seam_fwd(addr, payload: bytes) -> bytes:
+    """One mis-steered datagram, with its original source address, for
+    the owning shard to replay as if the kernel had delivered it there."""
+    import socket as _socket
+
+    host, port = addr[0], addr[1]
+    if not 0 <= port < (1 << 16):
+        raise ProtocolError(f"seam fwd port out of range: {port}")
+    try:
+        ip4 = _socket.inet_aton(host)
+    except OSError as exc:
+        raise ProtocolError(f"seam fwd needs an IPv4 source: {host!r}") from exc
+    return _seal(
+        _BIN_SEAM_FWD_HEAD.pack(_TAG_SEAM_FWD, ip4, port) + bytes(payload)
+    )
+
+
+def _seam_ckey_bytes(ckey: str) -> bytes:
+    raw = ckey.encode("utf-8", "strict")
+    if not raw or len(raw) > SEAM_CKEY_MAX:
+        raise ProtocolError(
+            f"seam ckey must be 1..{SEAM_CKEY_MAX} utf-8 bytes"
+        )
+    return raw
+
+
+def encode_seam_bind(origin: int, ckey: str, cjid: int) -> bytes:
+    if not (0 <= origin < 256 and 0 <= cjid < _U64):
+        raise ProtocolError("seam bind fields out of range")
+    return _seal(
+        _BIN_SEAM_BIND_HEAD.pack(_TAG_SEAM_BIND, origin, cjid)
+        + _seam_ckey_bytes(ckey)
+    )
+
+
+def encode_seam_rebind(
+    origin: int, conn_id: int, ckey: str, cjid: int
+) -> bytes:
+    if not (0 <= origin < 256 and 0 <= conn_id < (1 << 32)
+            and 0 <= cjid < _U64):
+        raise ProtocolError("seam rebind fields out of range")
+    return _seal(
+        _BIN_SEAM_REBIND_HEAD.pack(_TAG_SEAM_REBIND, origin, conn_id, cjid)
+        + _seam_ckey_bytes(ckey)
+    )
+
+
+def encode_seam_answer(
+    conn_id: int, cjid: int, payload: bytes, *, miss: bool = False
+) -> bytes:
+    if not (0 <= conn_id < (1 << 32) and 0 <= cjid < _U64):
+        raise ProtocolError("seam answer fields out of range")
+    if miss and payload:
+        raise ProtocolError("a seam miss carries no payload")
+    flags = _SEAM_ANSWER_MISS if miss else 0
+    return _seal(
+        _BIN_SEAM_ANSWER_HEAD.pack(_TAG_SEAM_ANSWER, flags, conn_id, cjid)
+        + bytes(payload)
+    )
+
+
+def encode_seam_quota(origin: int, ckey: str, admitted: int) -> bytes:
+    if not (0 <= origin < 256 and 0 <= admitted < _U64):
+        raise ProtocolError("seam quota fields out of range")
+    return _seal(
+        _BIN_SEAM_QUOTA_HEAD.pack(_TAG_SEAM_QUOTA, origin, admitted)
+        + _seam_ckey_bytes(ckey)
+    )
+
+
+_SEAM_HEADS = {
+    _TAG_SEAM_FWD: _BIN_SEAM_FWD_HEAD,
+    _TAG_SEAM_BIND: _BIN_SEAM_BIND_HEAD,
+    _TAG_SEAM_REBIND: _BIN_SEAM_REBIND_HEAD,
+    _TAG_SEAM_ANSWER: _BIN_SEAM_ANSWER_HEAD,
+    _TAG_SEAM_QUOTA: _BIN_SEAM_QUOTA_HEAD,
+}
+
+
+def decode_seam(raw) -> tuple:
+    """Decode one seam frame to a ``(kind, ...)`` tuple:
+
+    - ``("fwd", (host, port), payload)``
+    - ``("bind", origin, ckey, cjid)``
+    - ``("rebind", origin, conn_id, ckey, cjid)``
+    - ``("answer", miss, conn_id, cjid, payload)``
+    - ``("quota", origin, ckey, admitted)``
+
+    Raises :class:`ProtocolError` on truncation, CRC failure, unknown
+    tags, or malformed ckeys — the receiving shard drops the frame (the
+    seam is a hint channel with miss fallbacks; it must never crash a
+    serve loop)."""
+    import socket as _socket
+
+    n = len(raw)
+    if n < 1:
+        raise ProtocolError("empty seam frame")
+    head = _SEAM_HEADS.get(raw[0])
+    if head is None:
+        raise ProtocolError(f"unknown seam frame tag {raw[0]:#04x}")
+    if n < head.size + _CRC.size:
+        raise ProtocolError(f"seam frame truncated: {n} bytes")
+    view = memoryview(raw)
+    if (
+        zlib.crc32(view[: n - _CRC.size])
+        != _CRC.unpack_from(raw, n - _CRC.size)[0]
+    ):
+        raise ProtocolError("seam frame failed its checksum")
+    tail = bytes(view[head.size : n - _CRC.size])
+    tag = raw[0]
+    try:
+        if tag == _TAG_SEAM_FWD:
+            _, ip4, port = head.unpack_from(raw)
+            return ("fwd", (_socket.inet_ntoa(ip4), port), tail)
+        if tag == _TAG_SEAM_BIND:
+            _, origin, cjid = head.unpack_from(raw)
+            return ("bind", origin, tail.decode("utf-8"), cjid)
+        if tag == _TAG_SEAM_REBIND:
+            _, origin, conn_id, cjid = head.unpack_from(raw)
+            return ("rebind", origin, conn_id, tail.decode("utf-8"), cjid)
+        if tag == _TAG_SEAM_ANSWER:
+            _, flags, conn_id, cjid = head.unpack_from(raw)
+            return (
+                "answer", bool(flags & _SEAM_ANSWER_MISS), conn_id, cjid,
+                tail,
+            )
+        _, origin, admitted = head.unpack_from(raw)
+        return ("quota", origin, tail.decode("utf-8"), admitted)
+    except (struct.error, UnicodeDecodeError) as exc:
+        raise ProtocolError(f"malformed seam frame: {exc}") from exc
+
+
 def _request_obj(msg: Request) -> dict:
     obj = {
         "kind": "request",
