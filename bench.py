@@ -1676,6 +1676,132 @@ def bench_workload_dev(
     return out
 
 
+def bench_fabric(seed: int = 0, smoke: bool = False) -> dict:
+    """Compute-fabric figures (ISSUE 20), CPU-only like the other
+    loadgen-backed sections. Three measurements:
+
+    - the opaque-domain pairing: the SAME coordinator + CpuMiner plane
+      serves hashcore jobs (params a few bytes) and dict jobs (the
+      whole candidate catalog rides ``Request.data`` through windowed
+      dispatch) closed-loop over identical index ranges —
+      ``fabric_jobs_per_s_{hashcore,dict}``. The gap is the opaque
+      domain's shipping + windowing cost on the shared plane, a
+      number, not a belief.
+    - the streaming drill (``loadgen --scenario stream``, kill -9 +
+      replay included): ``fabric_time_to_first_partial_ms`` vs
+      ``fabric_time_to_final_ms`` — what partial emission buys a
+      client over waiting for the exact final.
+    - the starvation A/B (``loadgen --scenario starve``):
+      ``fabric_drr_fairness_ratio`` (weight-normalized drain split
+      under a greedy dict flood) and the mining tenants' p99 ratio
+      against the flood-free baseline.
+
+    ``fabric_violations`` sums both scenarios' check verdicts;
+    0 = every streaming/starvation assertion held.
+    """
+    import asyncio
+
+    loadgen = _import_loadgen()
+
+    upper = 4095 if smoke else 16383
+
+    async def arm(workload: str) -> float:
+        from tpuminter.coordinator import Coordinator
+        from tpuminter.lsp import LspClient
+        from tpuminter.lsp.params import FAST
+        from tpuminter.protocol import (
+            PowMode,
+            Request,
+            Result,
+            WorkResult,
+            decode_msg,
+            encode_msg,
+        )
+        from tpuminter.worker import CpuMiner, run_miner
+        from tpuminter.workloads import dictsearch as ds
+        from tpuminter.workloads import hashcore as hc
+
+        coord = await Coordinator.create(params=FAST, chunk_size=2048)
+        serve = asyncio.ensure_future(coord.serve())
+        miners = [
+            asyncio.ensure_future(
+                run_miner("127.0.0.1", coord.port, CpuMiner())
+            )
+            for _ in range(2)
+        ]
+        # one catalog, packed once: per-job cost is the SHIPPING and
+        # windowed dispatch of upper+1 opaque candidates, the seam the
+        # pairing is pricing (hashcore ships ~20 params bytes instead)
+        catalog = ds.pack_params(
+            "fmin", 0xFAB5EED,
+            [b"f%07d" % i for i in range(upper + 1)],
+        )
+        jobs = 0
+        client = await LspClient.connect("127.0.0.1", coord.port, FAST)
+        t0 = time.perf_counter()
+        try:
+            while time.perf_counter() - t0 < (1.0 if smoke else 1.5):
+                jobs += 1
+                if workload == "dict":
+                    data = catalog
+                else:
+                    data = hc.pack_params(
+                        "fmin", seed=jobs, threshold=0
+                    )
+                client.write(encode_msg(Request(
+                    job_id=jobs, mode=PowMode.MIN, lower=0, upper=upper,
+                    data=data, workload=workload,
+                )))
+                while True:
+                    msg = decode_msg(await client.read())
+                    if (
+                        isinstance(msg, (Result, WorkResult))
+                        and msg.job_id == jobs
+                    ):
+                        break
+            dt = time.perf_counter() - t0
+        finally:
+            await client.close(drain_timeout=0.2)
+            for t in miners:
+                t.cancel()
+            serve.cancel()
+            await asyncio.gather(serve, *miners, return_exceptions=True)
+            await coord.close()
+        return jobs / dt
+
+    hc_jps = asyncio.run(arm("hashcore"))
+    dict_jps = asyncio.run(arm("dict"))
+    stream = asyncio.run(loadgen.run_stream(
+        3, candidates=20000 if smoke else 60000, seed=seed,
+    ))
+    starve = asyncio.run(loadgen.run_starve(
+        4, duration=1.0 if smoke else 2.0, seed=seed,
+    ))
+    base = starve.get("baseline", {})
+    flood = starve.get("flood", {})
+    p_base = base.get("mine_p99_ms") or 0.0
+    p_flood = flood.get("mine_p99_ms") or 0.0
+    return {
+        "fabric_violations": (
+            len(loadgen.stream_check(stream))
+            + len(loadgen.starve_check(starve))
+        ),
+        "fabric_jobs_per_s_hashcore": round(hc_jps, 2),
+        "fabric_jobs_per_s_dict": round(dict_jps, 2),
+        "fabric_time_to_first_partial_ms": stream.get(
+            "time_to_first_partial_ms"
+        ),
+        "fabric_time_to_final_ms": stream.get("time_to_final_ms"),
+        "fabric_stream_partials": stream.get("partials"),
+        "fabric_drr_fairness_ratio": starve.get("drr_fairness_ratio"),
+        "fabric_flood_mine_p99_ratio": (
+            round(p_flood / p_base, 3) if p_base else None
+        ),
+        "fabric_flood_parked": flood.get("jobs_parked"),
+        "fabric_flood_shed": flood.get("parked_shed"),
+    }
+
+
 def bench_native(seconds: float = 2.0) -> dict:
     """Measured native C++ double-SHA rate (README's backend table row;
     BASELINE.md quoted 1.84 MH/s on this host). Absent .so → empty."""
@@ -1749,6 +1875,7 @@ def main() -> None:
         extra.update(bench_rolled_cp(duration=1.0, smoke=True))
         extra.update(bench_workload(duration=1.0, smoke=True))
         extra.update(bench_workload_dev(duration=0.5, smoke=True))
+        extra.update(bench_fabric(smoke=True))
         extra.update(bench_native(seconds=0.5))
     elif jax.default_backend() == "cpu":
         # the TPU tunnel is down and jax silently fell back to CPU: say
@@ -1772,6 +1899,7 @@ def main() -> None:
         extra.update(bench_rolled_cp())
         extra.update(bench_workload())
         extra.update(bench_workload_dev())
+        extra.update(bench_fabric())
         extra.update(bench_native())
     else:
         # persistent compilation cache, same as the worker CLI: the
@@ -1810,6 +1938,7 @@ def main() -> None:
         extra.update(bench_rolled_cp())
         extra.update(bench_workload())
         extra.update(bench_workload_dev())
+        extra.update(bench_fabric())
         extra.update(bench_native())
     ghs = rate / 1e9
     print(

@@ -338,15 +338,24 @@ async def _instant_miner(
 async def _resilient_instant_miner(ports, params: Params,
                                    seed: int, *,
                                    binary: bool = True,
-                                   on_session=None) -> None:
+                                   on_session=None,
+                                   clock=None) -> None:
     """An instant miner that survives coordinator restarts: when the
     connection is lost it redials with jittered exponential backoff and
     re-Joins (the crash scenario's fleet). ``ports`` may be one port or
     a list — the failover scenario's address rotation: each failure
     moves to the next port, so the fleet lands on a promoted standby
     (an un-promoted one rejects the dial, which just advances the
-    rotation)."""
+    rotation).
+
+    ``clock`` is this miner's retry/backoff clock seam (ISSUE 20): the
+    clock_skew chaos cell installs a per-miner ``ClockSkewPlan.fork``
+    here so BOTH ends of the conversation lie about time, differently —
+    a drifting worker clock stretches or shrinks the real redial wait,
+    which may only ever degrade to a delayed redial."""
     import random as _random
+
+    from tpuminter.worker import _sleep_on
 
     if isinstance(ports, int):
         ports = [ports]
@@ -367,7 +376,7 @@ async def _resilient_instant_miner(ports, params: Params,
             delays = jittered_backoff(0.05, 1.0, rng)  # had a session
         except LspConnectError:
             pass
-        await asyncio.sleep(next(delays))
+        await _sleep_on(clock, next(delays))
 
 
 async def _client_loop(port: int, params: Params, cid: int, upper: int,
@@ -2021,8 +2030,9 @@ _WL_SEED = 0xD1CE
 
 def _wl_shapes(upper: int, k: int = 4) -> list:
     """One submission template per fold discipline — ``(name, params
-    bytes, checker)`` — each checker judging the decoded job-level
-    accumulator against the locally-computed exact answer.
+    bytes, checker, workload, upper)`` — each checker judging the
+    decoded job-level accumulator against the locally-computed exact
+    answer.
 
     ``fmatch`` ships twice: a guaranteed hit (threshold = the global
     minimum, so the first match IS the argmin and the early-cancel
@@ -2045,22 +2055,57 @@ def _wl_shapes(upper: int, k: int = 4) -> list:
     total = sum(vals)
     return [
         ("fmin", hc.pack_params("fmin", _WL_SEED),
-         lambda acc: list(acc or ()) == [lo_val, lo_idx]),
+         lambda acc: list(acc or ()) == [lo_val, lo_idx],
+         "hashcore", upper),
         ("topk", hc.pack_params("topk", _WL_SEED, k=k),
-         lambda acc: [tuple(p) for p in acc or ()] == topk),
+         lambda acc: [tuple(p) for p in acc or ()] == topk,
+         "hashcore", upper),
         ("fmatch_hit", hc.pack_params("fmatch", _WL_SEED, threshold=lo_val),
          lambda acc: acc is not None and acc[0] == lo_idx
-         and acc[1] == lo_val),
+         and acc[1] == lo_val, "hashcore", upper),
         ("fmatch_dry", hc.pack_params("fmatch", _WL_SEED, threshold=0),
          lambda acc: acc is not None and acc[0] is None
-         and acc[2] == upper + 1),
+         and acc[2] == upper + 1, "hashcore", upper),
         ("fsum", hc.pack_params("fsum", _WL_SEED),
-         lambda acc: list(acc or ()) == [total, upper + 1]),
+         lambda acc: list(acc or ()) == [total, upper + 1],
+         "hashcore", upper),
+    ]
+
+
+def _dict_shapes(n: int = 3000) -> list:
+    """Opaque-domain shapes for the workload drill (ISSUE 20): a
+    ``dict`` catalog big enough that the coordinator MUST window it
+    (``len(data) > dictsearch.WINDOW_BYTES`` → per-chunk Setups carry
+    only each chunk's slice), pushed through the same crash + failover
+    legs as the hashcore shapes. ``dict_fsum`` is the exactly-once
+    probe in its sharpest form: its accumulator is ``[Σ score, count]``
+    over the whole catalog, so a candidate scored zero times or twice
+    — a lost window, a replayed settle double-fold — lands on the
+    exact-value check, not just on delivery bookkeeping."""
+    from tpuminter.workloads import dictsearch as ds
+
+    seed = _WL_SEED & 0xFFFFFFFF
+    cands = [b"cand-%06d-tpuminter" % i for i in range(n)]
+    data_fmin = ds.pack_params("fmin", seed, cands)
+    if len(data_fmin) <= ds.WINDOW_BYTES:
+        raise RuntimeError(
+            "dict drill catalog too small to exercise windowed dispatch"
+        )
+    scores = [ds.score(seed, c) for c in cands]
+    lo_val, lo_idx = min((v, i) for i, v in enumerate(scores))
+    total = sum(scores)
+    return [
+        ("dict_fmin", data_fmin,
+         lambda acc: list(acc or ()) == [lo_val, lo_idx],
+         "dict", n - 1),
+        ("dict_fsum", ds.pack_params("fsum", seed, cands),
+         lambda acc: list(acc or ()) == [total, n],
+         "dict", n - 1),
     ]
 
 
 async def _workload_client_loop(
-    ports, params: Params, cid: int, shapes, upper: int, ledger: dict,
+    ports, params: Params, cid: int, shapes, ledger: dict,
 ) -> None:
     """The durable client loop (:func:`_durable_client_loop`) for
     pluggable-workload jobs: cycles through ``shapes`` (one Request
@@ -2108,11 +2153,13 @@ async def _workload_client_loop(
                 if pending is None:
                     if ledger.get("stop"):
                         return
-                    name, data, check = shapes[(cid + jid) % len(shapes)]
+                    name, data, check, wl, hi = (
+                        shapes[(cid + jid) % len(shapes)]
+                    )
                     jid += 1
                     req = Request(
-                        job_id=jid, mode=PowMode.MIN, lower=0, upper=upper,
-                        data=data, client_key=ckey, workload="hashcore",
+                        job_id=jid, mode=PowMode.MIN, lower=0, upper=hi,
+                        data=data, client_key=ckey, workload=wl,
                     )
                     pending = (req, name, check)
                     ledger["submitted"] += 1
@@ -2245,7 +2292,10 @@ async def run_workload(
     if chunks_per_job is None:
         chunks_per_job = max(4, n_miners)
     upper = chunk_size * chunks_per_job - 1
-    shapes = _wl_shapes(upper)
+    # the hashcore discipline cycle plus the opaque-domain dict shapes
+    # (ISSUE 20): every client interleaves both families, so the crash
+    # and failover legs below hit windowed dict catalogs too
+    shapes = _wl_shapes(upper) + _dict_shapes()
     ledger = {"answers": {}, "by_fold": {}, "submitted": 0, "stop": False}
 
     def spawn_miner(i: int):
@@ -2260,13 +2310,13 @@ async def run_workload(
     miners = [spawn_miner(i) for i in range(n_miners)]
     clients = [
         asyncio.ensure_future(
-            _workload_client_loop(port, params, i, shapes, upper, ledger)
+            _workload_client_loop(port, params, i, shapes, ledger)
         )
         for i in range(n_clients)
     ]
     metrics: dict = {
         "fleet": n_miners, "clients": n_clients, "chunk_size": chunk_size,
-        "folds": [name for name, _data, _check in shapes],
+        "folds": [s[0] for s in shapes],
     }
     state = {"coord": coord}
     try:
@@ -2412,6 +2462,679 @@ def workload_check(metrics: dict) -> list:
             "answers above were computed by the host fallback, so the "
             "device/host equality claim is vacuous"
         )
+    return bad
+
+
+# ---------------------------------------------------------------------------
+# compute-fabric scenarios (ISSUE 20): streaming folds, weighted-fair
+# admission under a greedy flood, and the leak-hunting soak
+# ---------------------------------------------------------------------------
+
+
+async def run_stream(
+    n_miners: int = 3,
+    *,
+    candidates: int = 60000,
+    # small chunks on purpose: the drill must be CONTROL-PLANE-bound
+    # (hundreds of journaled settles, each a potential Emit), not
+    # compute-bound — a CPU fleet scores a smoke-sized catalog in tens
+    # of milliseconds, faster than the killed client can rebind
+    chunk_size: int = 32,
+    params: Params = FAST,
+    seed: int = 0,
+    drain: float = 30.0,
+) -> dict:
+    """The streaming-fold drill (ISSUE 20): a windowed dict catalog is
+    submitted with ``stream=True`` against a journaled coordinator
+    (``emit_interval=0`` — every durable settle emits), the coordinator
+    is ``kill -9``'d after the first partial lands and restarted from
+    its journal on the same port, and the reconnecting client keeps
+    collecting partials. Gates (``stream_check``):
+
+    - ≥ 3 partials, and the RAW observed coverage sequence — across
+      the crash, with NO client-side gating — is strictly increasing:
+      a replayed coordinator's first Emit already covers at least
+      everything it ever emitted before dying, because Emits are gated
+      on journaled settles;
+    - the streamed job's final payload is brute-force-exact AND
+      bit-identical to a non-streaming submission of the same job.
+    """
+    import shutil
+    from dataclasses import replace as dc_replace
+
+    from tpuminter.client import submit
+    from tpuminter.worker import CpuMiner, run_miner_reconnect
+    from tpuminter import workloads
+    from tpuminter.workloads import dictsearch as ds
+
+    dseed = (0xFAB0 + seed) & 0xFFFFFFFF
+    # short entries: the catalog must be big enough that the REPLAYED
+    # incarnation still has well over a client-rebind's worth of
+    # scoring left after the kill (a tiny catalog finishes before the
+    # reconnecting client rebinds — the across-the-replay leg of the
+    # gate would be vacuous), yet still fit one Request message
+    cands = [b"s%07d" % i for i in range(candidates)]
+    data = ds.pack_params("fmin", dseed, cands)
+    if len(data) <= ds.WINDOW_BYTES:
+        raise RuntimeError("stream catalog too small to window")
+    scores = [ds.score(dseed, c) for c in cands]
+    truth = min((v, i) for i, v in enumerate(scores))
+
+    tmpdir = tempfile.mkdtemp(prefix="tpuminter-stream-")
+    journal_path = os.path.join(tmpdir, "stream.wal")
+    coord = await make_coordinator(
+        params=params, chunk_size=chunk_size, recover_from=journal_path,
+        emit_interval=0.0,
+    )
+    port = coord.port
+    serve = asyncio.ensure_future(coord.serve())
+    miners = [
+        asyncio.ensure_future(run_miner_reconnect(
+            "127.0.0.1", port, CpuMiner(), params=params,
+            base_backoff=0.05, max_backoff=0.5,
+        ))
+        for _ in range(n_miners)
+    ]
+    partials: list = []  # (covered, total, t) — RAW, unfiltered
+    t0 = time.monotonic()
+    req = Request(
+        job_id=1, mode=PowMode.MIN, lower=0, upper=candidates - 1,
+        data=data, client_key="loadgen-stream", workload="dict",
+        stream=True,
+    )
+    task = asyncio.ensure_future(submit(
+        "127.0.0.1", port, req, params=params,
+        client_key="loadgen-stream", reconnect=True,
+        on_emit=lambda e: partials.append(
+            (e.covered, e.total, time.monotonic() - t0)
+        ),
+    ))
+    metrics: dict = {
+        "candidates": candidates, "chunk_size": chunk_size,
+        "fleet": n_miners, "seed": seed,
+    }
+    state = {"coord": coord}
+    try:
+        # wait for the first partial, then kill -9 mid-stream (but only
+        # while real coverage remains — a crash after the final Result
+        # would test nothing)
+        while not partials and not task.done():
+            if time.monotonic() - t0 > drain:
+                break
+            await asyncio.sleep(0.002)
+        t_mark = time.monotonic() - t0
+        metrics["crashed_mid_stream"] = bool(partials) and not task.done()
+        if metrics["crashed_mid_stream"]:
+            state["coord"] = None
+            serve.cancel()
+            await asyncio.gather(serve, return_exceptions=True)
+            await _crash_coordinator(coord)
+            for att in range(50):
+                try:
+                    coord = await make_coordinator(
+                        port, params=params, chunk_size=chunk_size,
+                        recover_from=journal_path, emit_interval=0.0,
+                    )
+                    break
+                except OSError:
+                    if att == 49:
+                        raise
+                    await asyncio.sleep(0.02)
+            state["coord"] = coord
+            serve = asyncio.ensure_future(coord.serve())
+            # partials stamped after THIS point are from the replayed
+            # incarnation (pre-crash datagrams still in the client's
+            # socket buffer decode before the restart completes)
+            t_mark = time.monotonic() - t0
+        res = await asyncio.wait_for(task, drain)
+        metrics["time_to_first_partial_ms"] = (
+            round(partials[0][2] * 1e3, 3) if partials else None
+        )
+        metrics["time_to_final_ms"] = round(
+            (time.monotonic() - t0) * 1e3, 3
+        )
+        covs = [c for c, _t, _s in partials]
+        metrics["partials"] = len(covs)
+        metrics["partials_pre_crash"] = sum(
+            1 for _c, _t, s in partials if s <= t_mark
+        )
+        metrics["partials_post_crash"] = sum(
+            1 for _c, _t, s in partials if s > t_mark
+        )
+        metrics["coverage_seq"] = covs[:64]
+        metrics["monotone"] = all(a < b for a, b in zip(covs, covs[1:]))
+        fold = workloads.fold_of(req)
+        acc = fold.decode(bytes(res.payload))
+        metrics["final_exact"] = list(acc) == list(truth)
+        # the non-streaming arm: same catalog, fresh job id, no crash —
+        # the final answer must be BIT-identical
+        plain = await asyncio.wait_for(submit(
+            "127.0.0.1", port, dc_replace(req, job_id=2, stream=False),
+            params=params, client_key="loadgen-stream-plain",
+        ), drain)
+        metrics["bit_identical_final"] = (
+            bytes(plain.payload) == bytes(res.payload)
+        )
+        # the RESTARTED coordinator's own counter: > 0 proves the
+        # replayed incarnation emitted, independent of client timing
+        metrics["emits_post_crash"] = state["coord"].stats["emits_sent"]
+        return metrics
+    finally:
+        task.cancel()
+        for t in miners:
+            t.cancel()
+        await asyncio.gather(task, *miners, return_exceptions=True)
+        serve.cancel()
+        await asyncio.gather(serve, return_exceptions=True)
+        if state["coord"] is not None:
+            await state["coord"].close()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def stream_check(metrics: dict) -> list:
+    """The streaming gate (ISSUE 20): ≥ 3 monotone partials, a crash
+    actually landed mid-stream, coverage never regressed across the
+    replay, and the final answer is exact and bit-identical to the
+    non-streaming run."""
+    bad = []
+    if metrics.get("partials", 0) < 3:
+        bad.append(
+            f"only {metrics.get('partials', 0)} partial(s) observed — "
+            f"the streaming gate wants >= 3 before the final answer"
+        )
+    if not metrics.get("crashed_mid_stream"):
+        bad.append(
+            "the coordinator was never killed mid-stream (the job "
+            "finished before the first partial was processed) — the "
+            "replay-non-regression claim went untested"
+        )
+    elif (
+        metrics.get("partials_post_crash", 0) < 1
+        or metrics.get("emits_post_crash", 0) < 1
+    ):
+        bad.append(
+            "the replayed incarnation never streamed to the rebound "
+            "client (partials_post_crash="
+            f"{metrics.get('partials_post_crash')}, emits_post_crash="
+            f"{metrics.get('emits_post_crash')}) — the job finished "
+            "before the client reconnected, so the across-the-replay "
+            "monotonicity leg is vacuous"
+        )
+    if not metrics.get("monotone", False):
+        bad.append(
+            f"RAW partial coverage regressed (seq: "
+            f"{metrics.get('coverage_seq')}) — a replayed Emit claimed "
+            f"less coverage than one the client already saw"
+        )
+    if not metrics.get("final_exact", False):
+        bad.append("streamed final answer != brute-force ground truth")
+    if not metrics.get("bit_identical_final", False):
+        bad.append(
+            "streamed final payload differs from the non-streaming "
+            "submission's — partial emission changed the fold"
+        )
+    return bad
+
+
+async def _starve_tenant(
+    port: int, params: Params, cid: int, *,
+    workload: Optional[str], data: Optional[bytes], upper: int,
+    inflight: int, out: dict, stop: dict, shed_pause: float = 0.01,
+) -> None:
+    """Open-loop tenant for the starvation drill: holds ``inflight``
+    submissions on one connection, replacing every answer (or shed
+    Refuse) immediately. A parked submission answers late — the park
+    path sends nothing until the DRR drain mints it — so the per-job
+    latency list IS the starvation probe. ``workload=None`` is the
+    background mining tenant; ``workload='dict'`` the greedy flood."""
+    c = await LspClient.connect("127.0.0.1", port, params)
+    ckey = f"starve-{workload or 'mine'}-{cid}"
+    jid = 0
+    t0: dict = {}
+    lat = out.setdefault("lat", [])
+
+    def fire() -> None:
+        nonlocal jid
+        jid += 1
+        t0[jid] = time.monotonic()
+        c.write(encode_msg(Request(
+            job_id=jid, mode=PowMode.MIN, lower=0, upper=upper,
+            data=data if data is not None else b"starve-%d-%d" % (cid, jid),
+            client_key=ckey, workload=workload,
+        )))
+
+    try:
+        for _ in range(inflight):
+            fire()
+        while t0:
+            msg = decode_msg(await c.read())
+            if isinstance(msg, (Result, WorkResult)) and msg.job_id in t0:
+                lat.append(time.monotonic() - t0.pop(msg.job_id))
+                out["done"] = out.get("done", 0) + 1
+                if not stop["stop"]:
+                    fire()
+            elif isinstance(msg, Refuse) and msg.job_id in t0:
+                t0.pop(msg.job_id)
+                out["shed"] = out.get("shed", 0) + 1
+                if not stop["stop"]:
+                    # greedy: replace a shed submission near-immediately
+                    # (the pause only keeps the Refuse loop from
+                    # saturating the event loop, it is far inside any
+                    # retry_after the coordinator asked for)
+                    await asyncio.sleep(shed_pause)
+                    fire()
+    except (LspConnectionLost, asyncio.CancelledError):
+        pass
+    finally:
+        await c.close(drain_timeout=0.2)
+
+
+async def _starve_arm(
+    flood: bool, *, n_miners: int, params: Params, duration: float,
+    weights: dict, park_capacity: int, max_jobs: int,
+    retry_after_ms: int, chunk_size: int, mine_upper: int,
+    dict_data: bytes, dict_upper: int, mine_inflight: int,
+    flood_inflight: int, drain: float = 15.0,
+) -> dict:
+    """One arm of the starvation A/B: the background mining tenants
+    always run; ``flood=True`` adds the greedy dict tenants. Identical
+    coordinator config both arms — the baseline measures the same park
+    machinery without contention."""
+    from tpuminter.worker import CpuMiner, run_miner_reconnect
+
+    coord = await make_coordinator(
+        params=params, chunk_size=chunk_size, max_jobs=max_jobs,
+        retry_after_ms=retry_after_ms, park_capacity=park_capacity,
+        workload_weights=dict(weights),
+    )
+    port = coord.port
+    serve = asyncio.ensure_future(coord.serve())
+    miners = [
+        asyncio.ensure_future(run_miner_reconnect(
+            "127.0.0.1", port, CpuMiner(), params=params,
+            base_backoff=0.05, max_backoff=0.5,
+        ))
+        for _ in range(n_miners)
+    ]
+    stop = {"stop": False}
+    mine_out: dict = {}
+    flood_out: dict = {}
+    tenants = [
+        asyncio.ensure_future(_starve_tenant(
+            port, params, i, workload=None, data=None, upper=mine_upper,
+            inflight=mine_inflight, out=mine_out, stop=stop,
+        ))
+        for i in range(2)
+    ]
+    if flood:
+        tenants += [
+            asyncio.ensure_future(_starve_tenant(
+                port, params, i, workload="dict", data=dict_data,
+                upper=dict_upper, inflight=flood_inflight,
+                out=flood_out, stop=stop,
+            ))
+            for i in range(2)
+        ]
+    try:
+        await asyncio.sleep(duration)
+        stop["stop"] = True
+        await asyncio.wait(tenants, timeout=drain)
+        lat = mine_out.get("lat", [])
+        arm = {
+            "mining_jobs": len(lat),
+            "mine_p50_ms": _pct_ms(lat, 50),
+            "mine_p99_ms": _pct_ms(lat, 99),
+            "flood_done": flood_out.get("done", 0),
+            "flood_shed": flood_out.get("shed", 0),
+            "jobs_parked": coord.stats["jobs_parked"],
+            "parked_shed": coord.stats["parked_shed"],
+            "parked_drained": coord.stats["parked_drained"],
+            "park_queue_high_water": coord.stats[
+                "park_queue_high_water"
+            ],
+            "drained_by_class": dict(coord.parked_drained_by_class),
+        }
+        return arm
+    finally:
+        for t in tenants + miners:
+            t.cancel()
+        await asyncio.gather(*tenants, *miners, return_exceptions=True)
+        serve.cancel()
+        await asyncio.gather(serve, return_exceptions=True)
+        await coord.close()
+
+
+async def run_starve(
+    n_miners: int = 4,
+    *,
+    duration: float = 2.0,
+    seed: int = 0,
+    params: Params = FAST,
+    chunk_size: int = 512,
+    max_jobs: int = 6,
+    # small on purpose: the flood holds 2 x flood_inflight submissions
+    # live, so a per-class capacity below that forces the LRU shed +
+    # explicit Refuse path the overflow gate demands
+    park_capacity: int = 16,
+    retry_after_ms: int = 100,
+    mine_upper: int = 8191,
+    # 2 tenants x 4 > the mine class's slot share: the mining backlog
+    # stays non-empty under flood, so the drain-count ratio measures
+    # the scheduler's weight split rather than work-conserving
+    # leftovers handed to the only backlogged class
+    mine_inflight: int = 4,
+    flood_inflight: int = 12,
+) -> dict:
+    """The starvation A/B (ISSUE 20): paired arms on an identically
+    configured coordinator — weights ``mine=2, dict=1``, a bounded
+    park queue, a small job table — once with only the background
+    mining tenants (the flood-free baseline) and once with greedy dict
+    tenants holding ``2 × flood_inflight`` submissions open. Gates
+    (``starve_check``): the flood demonstrably parked and shed, the
+    mining tenants' p99 stayed within 2× the baseline, and the DRR
+    drain counts track the weight share."""
+    from tpuminter.workloads import dictsearch as ds
+
+    weights = {"mine": 2.0, "dict": 1.0}
+    dseed = (0x57A7 + seed) & 0xFFFFFFFF
+    cands = [b"starve-%05d" % i for i in range(256)]
+    dict_data = ds.pack_params("fmin", dseed, cands)
+    kwargs = dict(
+        n_miners=n_miners, params=params, duration=duration,
+        weights=weights, park_capacity=park_capacity, max_jobs=max_jobs,
+        retry_after_ms=retry_after_ms, chunk_size=chunk_size,
+        mine_upper=mine_upper, dict_data=dict_data,
+        dict_upper=len(cands) - 1, mine_inflight=mine_inflight,
+        flood_inflight=flood_inflight,
+    )
+    base = await _starve_arm(False, **kwargs)
+    flood = await _starve_arm(True, **kwargs)
+    d = flood.get("drained_by_class", {})
+    mine_d, dict_d = d.get("mine", 0), d.get("dict", 0)
+    fairness = None
+    if mine_d > 0 and dict_d > 0:
+        fairness = round(
+            (dict_d / weights["dict"]) / (mine_d / weights["mine"]), 3
+        )
+    return {
+        "seed": seed, "fleet": n_miners, "weights": weights,
+        "max_jobs": max_jobs, "park_capacity": park_capacity,
+        "baseline": base, "flood": flood,
+        "drr_fairness_ratio": fairness,
+    }
+
+
+def starve_check(metrics: dict) -> list:
+    """The starvation gate (ISSUE 20): the flood actually parked and
+    overflowed, parked mining submissions kept draining at their DRR
+    share, and the background tenants' latency survived the flood."""
+    bad = []
+    base, flood = metrics.get("baseline", {}), metrics.get("flood", {})
+    if flood.get("jobs_parked", 0) <= 0:
+        bad.append(
+            "the greedy flood never parked a submission — the drill "
+            "measured an uncontended coordinator"
+        )
+    if flood.get("parked_shed", 0) <= 0:
+        bad.append(
+            "the park queue never overflowed: the flood was not "
+            "greedy enough to exercise the LRU shed + Refuse bound"
+        )
+    if flood.get("park_queue_high_water", 0) > (
+        metrics.get("park_capacity", 0) * 2  # per-class bound, 2 classes
+    ):
+        bad.append(
+            f"park high-water {flood.get('park_queue_high_water')} "
+            f"exceeded the per-class capacity bound"
+        )
+    for arm_name, arm in (("baseline", base), ("flood", flood)):
+        if arm.get("mining_jobs", 0) <= 0:
+            bad.append(f"{arm_name} arm answered no mining jobs at all")
+    p99b, p99f = base.get("mine_p99_ms"), flood.get("mine_p99_ms")
+    if p99b is not None and p99f is not None:
+        # the +100 ms grace absorbs two DRR drain ticks of scheduling
+        # quantum on a smoke-sized sample; the 2x factor is the gate
+        if p99f > 2.0 * p99b + 100.0:
+            bad.append(
+                f"mining p99 under flood ({p99f} ms) blew past 2x the "
+                f"flood-free baseline ({p99b} ms) — the greedy tenant "
+                f"starved the background one"
+            )
+    ratio = metrics.get("drr_fairness_ratio")
+    if ratio is None:
+        bad.append(
+            "one class never drained from the park queue — the DRR "
+            "fairness ratio is unmeasurable"
+        )
+    elif not (1 / 3 <= ratio <= 3.0):
+        bad.append(
+            f"weight-normalized drain ratio {ratio} is outside [1/3, 3]"
+            f" — the DRR drain does not track the configured weights"
+        )
+    return bad
+
+
+def _hw_gauges(coord) -> dict:
+    return {
+        k: v for k, v in sorted(coord.stats.items())
+        if k.endswith("_high_water")
+    }
+
+
+async def _soak_churn_client(
+    port: int, params: Params, pool: list, out: dict, stop: dict,
+) -> None:
+    """Short-lived one-job clients cycling through a fixed identity
+    pool: the session/bucket churn half of the soak — tables must
+    plateau at the pool size, not grow with the connection count."""
+    i = 0
+    while not stop["stop"]:
+        i += 1
+        try:
+            c = await LspClient.connect("127.0.0.1", port, params)
+        except LspConnectError:
+            await asyncio.sleep(0.05)
+            continue
+        try:
+            req = Request(
+                job_id=i, mode=PowMode.MIN, lower=0, upper=255,
+                data=b"soak-churn-%d" % i,
+                client_key=pool[i % len(pool)],
+            )
+            c.write(encode_msg(req))
+            deadline = time.monotonic() + 3.0
+            while time.monotonic() < deadline:
+                msg = decode_msg(await asyncio.wait_for(c.read(), 3.0))
+                if isinstance(msg, Result) and msg.job_id == i:
+                    out["done"] = out.get("done", 0) + 1
+                    break
+                if isinstance(msg, Refuse) and msg.job_id == i:
+                    if msg.retry_after_ms <= 0:
+                        break
+                    await asyncio.sleep(msg.retry_after_ms / 1000.0)
+                    c.write(encode_msg(req))
+        except (LspConnectionLost, asyncio.TimeoutError):
+            pass
+        finally:
+            await c.close(drain_timeout=0.1)
+
+
+async def run_soak(
+    *,
+    duration: float = 4.0,
+    seed: int = 0,
+    params: Params = FAST,
+    compact_bytes: int = 96 * 1024,
+    n_miners: int = 3,
+) -> dict:
+    """The leak-hunting soak (ISSUE 20): every bounded-state feature
+    armed at once — quotas, winner TTL + cap, UNBOUND reaper, the park
+    queue, a journal with a small live-compaction threshold — under a
+    steady mixed load (durable mining tenants, a dict workload tenant,
+    churning short-lived clients) plus a warmup park pulse. Every
+    ``*_high_water`` gauge is snapshotted at half-time and at the end:
+    ZERO growth in the second half is the leak gate — each table
+    provably plateaued — and the WAL must stay bounded by live
+    compaction (``compactions >= 1``, final bytes-on-disk within a
+    small multiple of the threshold)."""
+    import shutil
+
+    from tpuminter.worker import CpuMiner, run_miner_reconnect
+    from tpuminter.workloads import dictsearch as ds
+
+    tmpdir = tempfile.mkdtemp(prefix="tpuminter-soak-")
+    journal_path = os.path.join(tmpdir, "soak.wal")
+    coord = await make_coordinator(
+        params=params, chunk_size=256, recover_from=journal_path,
+        quota_rate=50.0, quota_burst=8, max_jobs=12,
+        retry_after_ms=100, winners_cap=128, winners_ttl=1.0,
+        unbound_ttl=1.0, park_capacity=32,
+        workload_weights={"mine": 1.0, "dict": 1.0},
+    )
+    # a small live-compaction threshold (the production default is
+    # 4 MiB — far past a short soak): installed directly, like chaos
+    # plans, so the WAL-bounded gate actually runs compactions
+    coord._journal._compact_bytes = compact_bytes
+    port = coord.port
+    serve = asyncio.ensure_future(coord.serve())
+    miners = [
+        asyncio.ensure_future(run_miner_reconnect(
+            "127.0.0.1", port, CpuMiner(), params=params,
+            base_backoff=0.05, max_backoff=0.5,
+        ))
+        for _ in range(n_miners)
+    ]
+    dseed = (0x50AC + seed) & 0xFFFFFFFF
+    cands = [b"soak-%04d" % i for i in range(200)]
+    scores = [ds.score(dseed, c) for c in cands]
+    lo = min((v, i) for i, v in enumerate(scores))
+    dict_shapes = [
+        ("dict_fmin", ds.pack_params("fmin", dseed, cands),
+         lambda acc: list(acc or ()) == list(lo), "dict", len(cands) - 1),
+        ("dict_fsum", ds.pack_params("fsum", dseed, cands),
+         lambda acc: list(acc or ()) == [sum(scores), len(cands)],
+         "dict", len(cands) - 1),
+    ]
+    mine_ledger = {"answers": {}, "submitted": 0, "stop": False}
+    wl_ledger = {"answers": {}, "by_fold": {}, "submitted": 0,
+                 "stop": False}
+    churn_out: dict = {}
+    stop = {"stop": False}
+    pool = [f"soak-pool-{i}" for i in range(6)]
+    tasks = [
+        asyncio.ensure_future(_durable_client_loop(
+            port, params, i, 2047, mine_ledger, verify=True
+        ))
+        for i in range(2)
+    ] + [
+        asyncio.ensure_future(_workload_client_loop(
+            port, params, 0, dict_shapes, wl_ledger
+        )),
+        asyncio.ensure_future(_soak_churn_client(
+            port, params, pool, churn_out, stop
+        )),
+    ]
+    metrics: dict = {
+        "seed": seed, "fleet": n_miners,
+        "duration": duration, "compact_bytes": compact_bytes,
+    }
+    try:
+        # warmup park pulse: one connection fires a burst far past its
+        # quota burst, pinning park_queue_high_water DURING the warmup
+        # half — the second half must never exceed it
+        await asyncio.sleep(0.3)
+        pulse = await LspClient.connect("127.0.0.1", port, params)
+        for j in range(24):
+            pulse.write(encode_msg(Request(
+                job_id=j + 1, mode=PowMode.MIN, lower=0,
+                upper=len(cands) - 1, data=dict_shapes[0][1],
+                client_key="soak-pulse", workload="dict",
+            )))
+        await asyncio.sleep(0.3)
+        await pulse.close(drain_timeout=0.1)
+        # -- half-time snapshot ------------------------------------------
+        await asyncio.sleep(max(0.1, duration / 2 - 0.6))
+        hw_mid = _hw_gauges(coord)
+        wal_mid = os.path.getsize(journal_path)
+        # -- second half: identical steady load --------------------------
+        await asyncio.sleep(duration / 2)
+        hw_end = _hw_gauges(coord)
+        wal_end = os.path.getsize(journal_path)
+        stop["stop"] = True
+        mine_ledger["stop"] = True
+        wl_ledger["stop"] = True
+        await asyncio.wait(tasks, timeout=10.0)
+        metrics["hw_mid"] = hw_mid
+        metrics["hw_end"] = hw_end
+        metrics["hw_growth"] = {
+            k: hw_end[k] - hw_mid.get(k, 0) for k in hw_end
+            if hw_end[k] != hw_mid.get(k, 0)
+        }
+        metrics["wal_mid_bytes"] = wal_mid
+        metrics["wal_end_bytes"] = wal_end
+        metrics["journal"] = dict(coord._journal.stats)
+        answers = mine_ledger["answers"]
+        metrics["mining_answered"] = sum(
+            1 for c in answers.values() if c >= 1
+        )
+        metrics["answers_duplicated"] = sum(
+            c - 1 for c in answers.values() if c > 1
+        )
+        metrics["poisoned_answers"] = mine_ledger.get("poisoned", 0)
+        metrics["dict_answered"] = sum(
+            wl_ledger["by_fold"].values()
+        )
+        metrics["answers_wrong"] = wl_ledger.get("answers_wrong", 0)
+        metrics["churn_done"] = churn_out.get("done", 0)
+        metrics["jobs_parked"] = coord.stats["jobs_parked"]
+        return metrics
+    finally:
+        for t in tasks + miners:
+            t.cancel()
+        await asyncio.gather(*tasks, *miners, return_exceptions=True)
+        serve.cancel()
+        await asyncio.gather(serve, return_exceptions=True)
+        await coord.close()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def soak_check(metrics: dict) -> list:
+    """The soak gate (ISSUE 20): zero second-half growth in EVERY
+    high-water gauge, live compaction demonstrably bounding the WAL,
+    and the steady load actually flowed (a soak over an idle
+    coordinator proves nothing)."""
+    bad = []
+    growth = metrics.get("hw_growth", {})
+    if growth:
+        bad.append(
+            f"high-water gauge(s) grew in the second half: {growth} — "
+            f"a table is still growing at steady state (leak)"
+        )
+    j = metrics.get("journal", {})
+    if j.get("compactions", 0) < 1:
+        bad.append(
+            "the journal never compacted — the WAL-bounded claim went "
+            "untested"
+        )
+    cap = 4 * metrics.get("compact_bytes", 1)
+    if metrics.get("wal_end_bytes", 0) > cap:
+        bad.append(
+            f"WAL ended at {metrics.get('wal_end_bytes')} bytes, past "
+            f"{cap} (4x the compaction threshold) — compaction is not "
+            f"keeping the disk bounded"
+        )
+    for k, floor in (
+        ("mining_answered", 1), ("dict_answered", 1), ("churn_done", 1),
+        ("jobs_parked", 1),
+    ):
+        if metrics.get(k, 0) < floor:
+            bad.append(f"soak load never exercised {k}")
+    if metrics.get("answers_duplicated", 0) > 0:
+        bad.append("duplicate answer(s) under soak")
+    if metrics.get("answers_wrong", 0) > 0:
+        bad.append("wrong dict answer(s) under soak")
+    if metrics.get("poisoned_answers", 0) > 0:
+        bad.append("unverifiable mining answer(s) under soak")
     return bad
 
 
@@ -2644,14 +3367,19 @@ async def _chaos_fleet_cell(
       every epoch, so liveness never trips) plus mute actors that
       handshake and never speak; the read/first-message deadlines must
       reap both while the honest ledger settles exactly once (ISSUE 18)
-    - ``clock_skew`` — the coordinator's OWN clocks lie (ISSUE 19
-      satellite): monotonic rate drifts ±50% per seeded segment and
-      wall time takes ±30 s NTP-style steps, installed mid-burst on the
-      clock seam. Everything downstream of ``_mono``/``_wall`` —
-      token-bucket refill, retry_after accrual, the winners age bound,
-      the UNBOUND reaper — must degrade to DELAYS, never to losses,
-      duplicates, or evictions; healing is the operator fixing the
-      clock, after which the ledger settles on honest time
+    - ``clock_skew`` — BOTH ends' clocks lie, differently (ISSUE 19
+      satellite + ISSUE 20): the coordinator's monotonic rate drifts
+      ±50% per seeded segment and wall time takes ±30 s NTP-style
+      steps, installed mid-burst on the clock seam, while each worker
+      runs an independently-seeded ``ClockSkewPlan.fork`` on its
+      retry/backoff clock; a blackout past the loss horizon forces the
+      fleet to redial through those skewed backoffs. Everything
+      downstream of ``_mono``/``_wall`` — token-bucket refill,
+      retry_after accrual, the winners age bound, the UNBOUND reaper —
+      and the workers' redial pacing must degrade to DELAYS, never to
+      losses, duplicates, or evictions; healing is the operator fixing
+      the coordinator clock, after which the ledger settles on honest
+      time (the worker forks keep lying, which must not matter)
     """
     import dataclasses
     import shutil
@@ -2700,12 +3428,22 @@ async def _chaos_fleet_cell(
             miner_ports[i] = w.endpoint.local_addr[1]
         return keep
 
+    # clock_skew lies to BOTH ends (ISSUE 20): each worker's
+    # retry/backoff clock seam gets an independently-seeded fork of the
+    # cell's plan — decorrelated streams, so the two sides disagree
+    # about how fast time passes, not just its value. The coordinator's
+    # own plan is installed mid-burst below, like every other fault.
+    worker_plans: list = []
+    if name == "clock_skew":
+        _base = ClockSkewPlan(seed)
+        worker_plans = [_base.fork(i + 1) for i in range(honest)]
     miners = [
         asyncio.ensure_future(_resilient_instant_miner(
             port, params, seed * 100 + i, binary=binary,
             on_session=(
                 _port_keeper(i) if name == "fleet_partition" else None
             ),
+            clock=worker_plans[i].mono if worker_plans else None,
         ))
         for i in range(honest)
     ]
@@ -2818,6 +3556,21 @@ async def _chaos_fleet_cell(
             clock_plan = ClockSkewPlan(seed)
             coord._mono = clock_plan.mono
             coord._wall = clock_plan.wall
+            # ...and knock every link dark past the loss horizon
+            # (ISSUE 20): the fleet must redial THROUGH its per-miner
+            # forked backoff clocks — both ends now lying about time,
+            # differently — and resume; in-flight chunks requeue on the
+            # horizon like any connection loss, so two-sided skew may
+            # only ever degrade to delays, never to a broken ledger
+            horizon = params.epoch_limit * params.epoch_seconds
+            plan = FaultPlan(seed)
+            plan.partition(
+                peer="*", direction="both", start=0.0,
+                duration=1.5 * horizon,
+            )
+            for ep in _endpoints(coord):
+                ep.set_fault_plan(plan)
+            fault_hold = max(fault, 3.0 * horizon)
         else:
             raise ValueError(f"unknown chaos cell {name!r}")
         if name == "byzantine":
@@ -2856,6 +3609,20 @@ async def _chaos_fleet_cell(
             coord._mono = time.monotonic
             coord._wall = time.time
             metrics["clock_stats"] = dict(clock_plan.stats)
+            # the worker forks keep lying through the drain (there is
+            # no operator on that side); the probe is whether the seam
+            # was demonstrably READ — a fork that never advanced means
+            # no miner ever redialed through its skewed backoff
+            metrics["worker_clock_stats"] = {
+                "forks": len(worker_plans),
+                "segments": sum(
+                    p.stats["segments"] for p in worker_plans
+                ),
+                "max_skew_s": max(
+                    (p.stats["max_skew_s"] for p in worker_plans),
+                    default=0.0,
+                ),
+            }
         if plan is not None:
             metrics["plan_stats"] = dict(plan.stats)
         if coord._journal is not None:
@@ -3217,6 +3984,18 @@ def chaos_check(metrics: dict, params: Params = FAST) -> list:
                 bad.append(
                     pre + "a lying coordinator clock got an honest "
                     "miner evicted"
+                )
+            ws = m.get("worker_clock_stats", {})
+            if ws.get("segments", 0) < 1:
+                bad.append(
+                    pre + "no worker ever read its forked backoff "
+                    "clock — the cell skewed only ONE end (ISSUE 20 "
+                    "wants both lying, differently)"
+                )
+            if ws.get("max_skew_s", 0.0) <= 0.0:
+                bad.append(
+                    pre + "the worker-side clock forks never diverged "
+                    "from true time"
                 )
         elif cell == "flapping_link":
             if m.get("lost_during_flap", 0) > 0:
@@ -3873,6 +4652,7 @@ def main(argv=None) -> int:
         choices=(
             "steady", "crash", "failover", "chaos", "zipf", "churn",
             "rolled", "workload", "chain-host", "multiproc",
+            "stream", "starve", "soak",
         ),
         default="steady",
         help="steady: the sustained-burst benchmark; crash: kill the "
@@ -3908,7 +4688,18 @@ def main(argv=None) -> int:
         "serves hashcore jobs across every registered fold discipline "
         "(fmin, top-k, first-match hit + dry, map-reduce sum) through "
         "a worker kill AND a coordinator kill -9 + journal restart, "
-        "gated on a per-fold EXACT-ANSWER exactly-once ledger",
+        "gated on a per-fold EXACT-ANSWER exactly-once ledger; "
+        "stream: the streaming-fold drill (ISSUE 20) — a windowed dict "
+        "catalog with stream=True, kill -9 after the first partial, "
+        "gated on >= 3 strictly-monotone raw partials across the "
+        "replay and a brute-force-exact, bit-identical final; starve: "
+        "the weighted-fair A/B (ISSUE 20) — a greedy dict flood vs "
+        "background mining tenants, gated on the flood parking + "
+        "shedding, mining p99 <= 2x the flood-free baseline, and DRR "
+        "drain counts tracking the weight share; soak: every bounded-"
+        "state feature armed under steady mixed load (ISSUE 20), "
+        "gated on ZERO second-half growth in every *_high_water gauge "
+        "and a WAL bounded by live compaction",
     )
     parser.add_argument(
         "--roll-budget", type=int, default=16, metavar="N",
@@ -4164,6 +4955,44 @@ def main(argv=None) -> int:
         violations = workload_check(metrics)
         for v in violations:
             print(f"WORKLOAD FAIL: {v}", file=sys.stderr)
+        return 1 if violations else 0
+    if args.scenario == "stream":
+        metrics = asyncio.run(run_stream(
+            3 if args.smoke else max(3, args.miners),
+            candidates=20000 if args.smoke else 60000,
+            seed=args.seed,
+        ))
+        print(json.dumps(metrics) if args.json else
+              "\n".join(f"{k}: {v}" for k, v in metrics.items()))
+        # the drill IS its assertions, --smoke or not (like workload)
+        violations = stream_check(metrics)
+        for v in violations:
+            print(f"STREAM FAIL: {v}", file=sys.stderr)
+        return 1 if violations else 0
+    if args.scenario == "starve":
+        metrics = asyncio.run(run_starve(
+            4 if args.smoke else max(4, args.miners),
+            duration=min(args.duration, 1.5) if args.smoke
+            else max(2.0, args.duration),
+            seed=args.seed,
+        ))
+        print(json.dumps(metrics) if args.json else
+              "\n".join(f"{k}: {v}" for k, v in metrics.items()))
+        violations = starve_check(metrics)
+        for v in violations:
+            print(f"STARVE FAIL: {v}", file=sys.stderr)
+        return 1 if violations else 0
+    if args.scenario == "soak":
+        metrics = asyncio.run(run_soak(
+            duration=min(args.duration, 3.0) if args.smoke
+            else max(8.0, args.duration),
+            seed=args.seed,
+        ))
+        print(json.dumps(metrics) if args.json else
+              "\n".join(f"{k}: {v}" for k, v in metrics.items()))
+        violations = soak_check(metrics)
+        for v in violations:
+            print(f"SOAK FAIL: {v}", file=sys.stderr)
         return 1 if violations else 0
     if args.scenario == "multiproc":
         metrics = asyncio.run(run_multiproc(

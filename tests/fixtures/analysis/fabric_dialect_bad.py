@@ -1,0 +1,29 @@
+"""True-positive fixture: a careless compute-fabric port (ISSUE 20).
+
+A second opaque-domain workload's params codec reuses the dictsearch
+params tag 0xC5 (a Request.data frame for one workload would parse as
+the other's — the coordinator would fold indices against the wrong
+catalog), its streaming-partial layout claims the SAME tag in-module
+and collides on packed length with the params layout, nothing is
+sealed with the CRC trailer every fabric frame carries, u64 emission
+counters pack unguarded, and TWO ``*_WID`` constants claim workload
+id 2 — dictsearch's id, the dispatch key on binary WorkResult frames
+and recovered winner records. Parsed by tests/test_analysis.py, never
+imported.
+"""
+
+import struct
+
+FABCORE_WID = 2             # collides with dictsearch's DICT_WID
+FABCORE2_WID = 2            # and with its sibling in-module
+
+_TAG_FABPARAMS = 0xC5       # reuses the dict params tag
+_BIN_FABPARAMS = struct.Struct("<BBQQB")
+
+_TAG_FABEMIT = 0xC5         # duplicate tag in-module too
+_BIN_FABEMIT = struct.Struct("<BQQBB")  # same calcsize: length collision
+
+
+def encode_emit(job: int, seq: int, covered: int) -> bytes:
+    # u64 fields packed with no _U64 range guard, no CRC trailer
+    return _BIN_FABEMIT.pack(_TAG_FABEMIT, job, seq, covered & 0xFF, 0)

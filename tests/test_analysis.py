@@ -265,6 +265,83 @@ def test_codec_conformance_catches_bad_workload_port():
     assert "workload-id-collision:BADCORE_WID" not in symbols
 
 
+def test_codec_conformance_catches_bad_fabric_dialect():
+    """The ISSUE 20 bug class: a compute-fabric port that reuses the
+    dict params tag (in-module AND against the real dictsearch module),
+    collides on packed length, skips the CRC trailer, packs u64
+    emission counters unguarded, and claims dictsearch's workload id
+    must fail lint."""
+    from tpuminter.analysis import codec_conformance
+
+    findings = _fixture_findings(
+        "fabric_dialect_bad.py", ["codec-conformance"]
+    )
+    violations = {
+        f.symbol.split(":", 1)[0] for f in findings if ":" in f.symbol
+    }
+    assert "duplicate-tag" in violations
+    assert "length-collision" in violations
+    assert "missing-crc" in violations
+    assert any(
+        f.qualname == "encode_emit" and f.symbol == "_BIN_FABEMIT"
+        for f in findings
+    )
+    fixture = parse_module(
+        REPO_ROOT, os.path.join(FIXTURES, "fabric_dialect_bad.py")
+    )
+    dictsearch = parse_module(
+        REPO_ROOT, os.path.join("tpuminter", "workloads", "dictsearch.py")
+    )
+    project = codec_conformance.check_project([fixture, dictsearch])
+    symbols = {f.symbol for f in project}
+    # tag 0xC5 claimed by both modules: one wire namespace (every
+    # claimant after the first sorted one is flagged)
+    assert "cross-module-tag:_BIN_DICTPARAMS_HEAD" in symbols
+    # wid 2 claimed three times (twice in the fixture, once for real):
+    # the first claimant keeps the id, the other two are flagged
+    assert "workload-id-collision:FABCORE2_WID" in symbols
+    assert "workload-id-collision:DICT_WID" in symbols
+    assert "workload-id-collision:FABCORE_WID" not in symbols
+
+
+def test_codec_conformance_covers_the_live_fabric_dialect():
+    """The shipped fabric frames are under the checker's eye — the Emit
+    streaming partial (0xBE, protocol.py) and the dict params frame
+    (0xC5, dictsearch.py) parse out with the right tags, the variable-
+    length ``_HEAD`` marking, and the CRC seal; the merged table and
+    the cross-module tag/wid namespaces stay clean — so a regression
+    to either dialect fails lint, not just this suite."""
+    from tpuminter.analysis.codec_conformance import (
+        check_project,
+        check_table,
+        extract_kinds,
+        extract_wids,
+        struct_size,
+    )
+
+    proto = parse_module(REPO_ROOT, os.path.join("tpuminter", "protocol.py"))
+    dicts = parse_module(
+        REPO_ROOT, os.path.join("tpuminter", "workloads", "dictsearch.py")
+    )
+    hashcore = parse_module(
+        REPO_ROOT, os.path.join("tpuminter", "workloads", "hashcore.py")
+    )
+    kinds = {
+        k["name"]: k for k in extract_kinds(proto) + extract_kinds(dicts)
+    }
+    emit = kinds["_BIN_EMIT_HEAD"]
+    assert emit["tag"] == 0xBE
+    assert emit["has_crc"] and emit["variable"]
+    assert struct_size(emit["fmt"]) == 33  # 37 on the wire with the CRC
+    dp = kinds["_BIN_DICTPARAMS_HEAD"]
+    assert dp["tag"] == 0xC5
+    assert dp["has_crc"] and dp["variable"]
+    assert struct_size(dp["fmt"]) == 31
+    assert check_table(list(kinds.values())) == []
+    assert check_project([proto, dicts, hashcore]) == []
+    assert [w["name"] for w in extract_wids(dicts)] == ["DICT_WID"]
+
+
 def test_codec_conformance_covers_the_live_workload_codecs():
     """The registry-declared workload codecs are under the checker's
     eye: the hashcore params frame and every fold accumulator layout

@@ -127,3 +127,20 @@ def test_bench_smoke_emits_one_json_line():
         obj["extra"]["multiproc_quota_admitted"]
         <= obj["extra"]["multiproc_quota_burst"] + 1
     )
+    # the compute-fabric section rides every capture (ISSUE 20): the
+    # opaque-domain pairing measured both arms on the same plane, the
+    # streaming drill put a first partial strictly before the exact
+    # final, the starvation A/B measured a real weight split under a
+    # real flood, and every stream/starve check verdict held
+    assert obj["extra"]["fabric_violations"] == 0
+    assert obj["extra"]["fabric_jobs_per_s_hashcore"] > 0
+    assert obj["extra"]["fabric_jobs_per_s_dict"] > 0
+    assert (
+        0
+        < obj["extra"]["fabric_time_to_first_partial_ms"]
+        < obj["extra"]["fabric_time_to_final_ms"]
+    )
+    assert obj["extra"]["fabric_stream_partials"] >= 3
+    assert 1 / 3 <= obj["extra"]["fabric_drr_fairness_ratio"] <= 3.0
+    assert obj["extra"]["fabric_flood_parked"] > 0
+    assert obj["extra"]["fabric_flood_shed"] > 0

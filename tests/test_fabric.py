@@ -1,0 +1,837 @@
+"""The compute fabric (ISSUE 20), pinned seam by seam:
+
+- the dict workload's opaque-domain codec (tag 0xC5, u16 length-prefixed
+  entries, CRC trailer) — roundtrip, every corruption a loud refusal,
+  global-index windowing and the per-window chunk cap;
+- per-variant verification trust model over a shipped candidate list
+  (witnesses for fmin/topk, full recompute for fmatch/fsum);
+- the Emit wire dialect (tag 0xBE, CRC-sealed) and the ``"strm"``
+  no-flag-day Request key;
+- fold-state merge semantics under partial emission — deterministic
+  mirrors of the hypothesis-style properties (this image lacks
+  hypothesis): snapshots are monotone in coverage, duplicate/replayed
+  Emits never regress a gated client, WAL-segment merges compose;
+- the weighted-fair park queue driven at the unit level (stride
+  scheduling order, LRU shed + Refuse at overflow, nothing journaled
+  or minted while parked, dead/superseded entries dropped, late class
+  joins at the current virtual time);
+- real-fleet e2e: dict jobs through CpuMiners with exactly-once dedup,
+  streaming partials under a chaos FaultPlan, and windowed dispatch of
+  an over-budget catalog recombining exactly;
+- the tier-1 gates for ``loadgen --scenario stream|starve|soak``
+  (full-length soak rides behind ``-m slow``).
+"""
+
+import asyncio
+import json as _json
+import os
+import random
+import sys
+
+import pytest
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts",
+    ),
+)
+
+import loadgen  # noqa: E402  (scripts/ is not a package)
+
+from tpuminter import workloads  # noqa: E402
+from tpuminter.chaos import FaultPlan  # noqa: E402
+from tpuminter.client import submit  # noqa: E402
+from tpuminter.coordinator import Coordinator  # noqa: E402
+from tpuminter.lsp.params import FAST  # noqa: E402
+from tpuminter.protocol import (  # noqa: E402
+    Emit,
+    PowMode,
+    ProtocolError,
+    Refuse,
+    Request,
+    WorkResult,
+    decode_msg,
+    encode_msg,
+    request_from_obj,
+    request_to_obj,
+)
+from tpuminter.workloads import (  # noqa: E402
+    FMin,
+    FSum,
+    FirstMatch,
+    TopK,
+    absorb,
+    covered_span,
+    fold_of,
+    merge_states,
+    new_state,
+)
+from tpuminter.workloads import dictsearch as ds  # noqa: E402
+from tpuminter.worker import CpuMiner, run_miner  # noqa: E402
+
+
+def run(coro, timeout=60.0):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def _scores(seed, cands):
+    return [ds.score(seed, c) for c in cands]
+
+
+def _dreq(variant, seed, cands, *, job_id=1, threshold=0, k=1, ckey="",
+          stream=False, lo=0, hi=None, chunk_id=0):
+    return Request(
+        job_id=job_id, mode=PowMode.MIN, lower=lo,
+        upper=(len(cands) - 1 if hi is None else hi),
+        data=ds.pack_params(
+            variant, seed, cands, threshold=threshold, k=k
+        ),
+        client_key=ckey, workload="dict", stream=stream,
+        chunk_id=chunk_id,
+    )
+
+
+# ---------------------------------------------------------------------------
+# dict params codec: tag | fields | entry table | crc
+# ---------------------------------------------------------------------------
+
+class TestDictCodec:
+    def test_roundtrip_and_global_index_windowing(self):
+        cands = [b"alpha", b"", b"x" * 40, b"omega"]
+        data = ds.pack_params(
+            "topk", 0xFEED, cands, threshold=9, k=2, base=100
+        )
+        p = ds.parse_params(data)
+        assert (p.variant, p.seed, p.threshold, p.k, p.base) == (
+            "topk", 0xFEED, 9, 2, 100
+        )
+        assert p.entries == tuple(cands)
+        # entry() resolves GLOBAL indices through the window base
+        assert p.entry(100) == b"alpha"
+        assert p.entry(103) == b"omega"
+        for outside in (99, 104):
+            with pytest.raises(ValueError, match="outside"):
+                p.entry(outside)
+
+    def test_parse_cache_returns_the_same_object(self):
+        data = ds.pack_params("fmin", 7, [b"one", b"two"])
+        assert ds.parse_params(data) is ds.parse_params(bytes(data))
+
+    def test_pack_rejects_malformed_inputs(self):
+        with pytest.raises(ValueError, match="variant"):
+            ds.pack_params("fmax", 1, [b"a"])
+        with pytest.raises(ValueError, match="u64"):
+            ds.pack_params("fmin", 1 << 64, [b"a"])
+        with pytest.raises(ValueError, match="k must"):
+            ds.pack_params("topk", 1, [b"a"], k=0)
+        with pytest.raises(ValueError, match="count"):
+            ds.pack_params("fmin", 1, [])
+        with pytest.raises(ValueError, match="exceeds"):
+            ds.pack_params("fmin", 1, [b"x" * (ds.MAX_ENTRY + 1)])
+
+    def test_every_corruption_is_a_loud_refusal(self):
+        data = ds.pack_params("fmin", 3, [b"aa", b"bb", b"cc"])
+        # single-bit flip anywhere in the body: CRC catches it
+        for off in (0, 1, 10, len(data) - 6):
+            bent = bytearray(data)
+            bent[off] ^= 0x40
+            with pytest.raises(ValueError, match="CRC|tag|variant"):
+                ds.parse_params(bytes(bent))
+        # truncation at every prefix length is refused, never a crash
+        for cut in range(len(data)):
+            with pytest.raises(ValueError):
+                ds.parse_params(data[:cut])
+        # a lying entry count (resealed so the CRC passes) is caught by
+        # the entry-table walk, not trusted
+        head = ds._BIN_DICTPARAMS_HEAD
+        body = bytearray(data[:-4])
+        tag, variant, seed, threshold, k, base, count = head.unpack_from(
+            body
+        )
+        head.pack_into(
+            body, 0, tag, variant, seed, threshold, k, base, count + 1
+        )
+        with pytest.raises(ValueError, match="truncated"):
+            ds.parse_params(ds._seal(bytes(body)))
+        # trailing junk between the entries and the CRC is refused
+        with pytest.raises(ValueError, match="trailing"):
+            ds.parse_params(ds._seal(data[:-4] + b"\x00"))
+
+    def test_fold_for_enforces_the_shipped_range(self):
+        cands = [b"c%d" % i for i in range(8)]
+        req = _dreq("fmin", 5, cands)
+        assert isinstance(fold_of(req), FMin)
+        bad = _dreq("fmin", 5, cands, lo=0, hi=8)
+        with pytest.raises(ValueError, match="outside"):
+            ds.DictSearch().fold_for(bad)
+        # a window frame's base bounds the range from below too
+        win = Request(
+            job_id=1, mode=PowMode.MIN, lower=99, upper=101,
+            data=ds.pack_params("fmin", 5, cands, base=100),
+            workload="dict",
+        )
+        with pytest.raises(ValueError, match="outside"):
+            ds.DictSearch().fold_for(win)
+        # per-variant fold resolution
+        assert isinstance(fold_of(_dreq("topk", 5, cands, k=3)), TopK)
+        assert isinstance(
+            fold_of(_dreq("fmatch", 5, cands, threshold=9)), FirstMatch
+        )
+        assert isinstance(fold_of(_dreq("fsum", 5, cands)), FSum)
+
+    def test_window_and_chunk_cap_semantics(self):
+        small = _dreq("fmin", 1, [b"tiny"] * 4)
+        assert workloads.window_for(small, 0, 3) is None
+        assert workloads.chunk_cap(small) == 0
+        cands = [b"window-%06d" % i for i in range(2600)]
+        req = _dreq("fmin", 2, cands)
+        assert len(req.data) > ds.WINDOW_BYTES
+        cap = workloads.chunk_cap(req)
+        assert cap >= 16
+        hi = min(len(cands) - 1, 1000 + cap - 1)
+        win = workloads.window_for(req, 1000, hi)
+        assert win is not None and len(win) <= ds.WINDOW_BYTES + 64
+        p = ds.parse_params(win)
+        assert p.base == 1000
+        assert p.entries == tuple(cands[1000 : hi + 1])
+        assert p.entry(1000) == cands[1000]  # global index still works
+        with pytest.raises(ValueError, match="window"):
+            ds.DictSearch().window(req, 2599, 2600)
+
+
+# ---------------------------------------------------------------------------
+# compute + per-variant verification over a shipped list
+# ---------------------------------------------------------------------------
+
+class TestDictSemantics:
+    SEED = 0xD1C7
+    CANDS = [b"pw-%04d" % i for i in range(300)]
+
+    def _compute(self, req):
+        for msg in workloads.compute(req):
+            if msg is not None:
+                return msg
+        raise AssertionError("compute ended without a WorkResult")
+
+    def test_compute_matches_brute_force_per_variant(self):
+        vals = _scores(self.SEED, self.CANDS)
+        pairs = sorted((v, i) for i, v in enumerate(vals))
+        cases = [
+            ("fmin", dict(), [pairs[0][0], pairs[0][1]]),
+            ("topk", dict(k=3), [list(p) for p in pairs[:3]]),
+            ("fsum", dict(), [sum(vals), len(vals)]),
+        ]
+        for variant, kw, want in cases:
+            req = _dreq(variant, self.SEED, self.CANDS, **kw)
+            msg = self._compute(req)
+            assert msg.wid == ds.DICT_WID
+            assert fold_of(req).decode(msg.payload) == want, variant
+            assert workloads.verify_claim(req, msg), variant
+
+    def test_first_match_early_stop_and_dry_scan(self):
+        vals = _scores(self.SEED, self.CANDS)
+        pairs = sorted((v, i) for i, v in enumerate(vals))
+        hit = _dreq(
+            "fmatch", self.SEED, self.CANDS, threshold=pairs[3][0]
+        )
+        msg = self._compute(hit)
+        index, value, probes = fold_of(hit).decode(msg.payload)
+        first = next(i for i, v in enumerate(vals) if v <= pairs[3][0])
+        assert (index, value) == (first, vals[first])
+        assert msg.searched < len(self.CANDS)  # early-stop, not a scan
+        dry = _dreq("fmatch", self.SEED, self.CANDS, threshold=0)
+        dmsg = self._compute(dry)
+        assert fold_of(dry).decode(dmsg.payload)[0] is None
+        assert dmsg.searched == len(self.CANDS)
+        assert workloads.verify_claim(dry, dmsg)
+
+    def test_byzantine_claims_are_rejected(self):
+        vals = _scores(self.SEED, self.CANDS)
+        pairs = sorted((v, i) for i, v in enumerate(vals))
+        lo_v, lo_i = pairs[0]
+
+        def claim(req, acc):
+            fold = fold_of(req)
+            return WorkResult(
+                job_id=req.job_id, chunk_id=req.chunk_id,
+                wid=ds.DICT_WID,
+                searched=req.upper - req.lower + 1,
+                payload=fold.encode(acc),
+            )
+
+        cases = [
+            # wrong witness value for the claimed index
+            (_dreq("fmin", self.SEED, self.CANDS), [lo_v ^ 1, lo_i]),
+            # witness outside the chunk range
+            (_dreq("fmin", self.SEED, self.CANDS, hi=99),
+             [vals[150], 150]),
+            # a dry first-match claim hiding a real hit: rescan finds it
+            (_dreq("fmatch", self.SEED, self.CANDS, threshold=lo_v),
+             [None, None, len(self.CANDS)]),
+            # sum off by one
+            (_dreq("fsum", self.SEED, self.CANDS),
+             [sum(vals) + 1, len(vals)]),
+            # short count
+            (_dreq("fsum", self.SEED, self.CANDS),
+             [sum(vals), len(vals) - 1]),
+        ]
+        for req, acc in cases:
+            assert not workloads.verify_claim(req, claim(req, acc)), acc
+        # wrong wid never verifies
+        good = _dreq("fmin", self.SEED, self.CANDS)
+        msg = claim(good, [lo_v, lo_i])
+        assert workloads.verify_claim(good, msg)
+        bad_wid = WorkResult(
+            job_id=msg.job_id, chunk_id=msg.chunk_id, wid=99,
+            searched=msg.searched, payload=msg.payload,
+        )
+        assert not workloads.verify_claim(good, bad_wid)
+
+
+# ---------------------------------------------------------------------------
+# the Emit wire dialect and the "strm" Request key
+# ---------------------------------------------------------------------------
+
+class TestEmitWire:
+    def test_binary_roundtrip_is_tagged_and_crc_sealed(self):
+        e = Emit(job_id=7, seq=3, covered=120, total=999,
+                 payload=b"\x01\x02\x03")
+        raw = encode_msg(e, binary=True)
+        assert raw[0] == 0xBE
+        back = decode_msg(raw)
+        assert isinstance(back, Emit)
+        assert (back.job_id, back.seq, back.covered, back.total) == (
+            7, 3, 120, 999
+        )
+        assert bytes(back.payload) == b"\x01\x02\x03"
+        # JSON dialect carries the same fields
+        jback = decode_msg(encode_msg(e))
+        assert (jback.covered, jback.total) == (120, 999)
+        assert bytes(jback.payload) == b"\x01\x02\x03"
+
+    def test_corruption_and_truncation_are_loud(self):
+        raw = encode_msg(
+            Emit(job_id=1, seq=1, covered=5, total=9, payload=b"zz"),
+            binary=True,
+        )
+        bent = bytearray(raw)
+        bent[6] ^= 0x10
+        with pytest.raises(ProtocolError):
+            decode_msg(bytes(bent))
+        with pytest.raises(ProtocolError):
+            decode_msg(raw[:10])
+
+    def test_out_of_range_fields_fall_back_to_json(self):
+        e = Emit(job_id=1, seq=1, covered=1 << 64, total=1, payload=b"")
+        raw = encode_msg(e, binary=True)
+        assert raw[0] != 0xBE  # JSON fallback, not a corrupt frame
+
+    def test_strm_key_is_omitted_when_false(self):
+        req = _dreq("fmin", 1, [b"a", b"b"], ckey="k")
+        obj = request_to_obj(req)
+        assert "strm" not in obj  # an old coordinator sees no new key
+        assert request_from_obj(obj).stream is False
+        sobj = request_to_obj(
+            _dreq("fmin", 1, [b"a", b"b"], ckey="k", stream=True)
+        )
+        assert sobj["strm"] == 1  # the compact wire form
+        assert request_from_obj(sobj).stream is True
+
+
+# ---------------------------------------------------------------------------
+# fold-state merge under partial emission (deterministic mirrors of the
+# hypothesis-style properties; seeded RNG, no hypothesis in this image)
+# ---------------------------------------------------------------------------
+
+def _random_partition(rng, lo, hi):
+    cuts = sorted(rng.sample(range(lo + 1, hi + 1),
+                             rng.randint(0, min(8, hi - lo))))
+    spans, at = [], lo
+    for c in cuts + [hi + 1]:
+        spans.append((at, c - 1))
+        at = c
+    return spans
+
+
+class TestEmitMerge:
+    ENTRIES = [b"emit-%04d" % i for i in range(160)]
+
+    def _folds(self, rng, vals):
+        return (
+            FMin(), TopK(3), FirstMatch(rng.choice(sorted(vals)[:8])),
+            FSum(),
+        )
+
+    def test_partial_snapshots_are_monotone_and_converge(self):
+        """Absorbing settles in any order yields emission snapshots
+        whose coverage strictly increases, whose payloads roundtrip the
+        fold codec, and whose last state equals the whole-range fold —
+        what makes a stream of Emits a converging answer."""
+        rng = random.Random(0xE517)
+        for trial in range(20):
+            seed = rng.randrange(1 << 32)
+            n = rng.randint(20, len(self.ENTRIES))
+            vals = _scores(seed, self.ENTRIES[:n])
+            for fold in self._folds(rng, vals):
+                spans = _random_partition(rng, 0, n - 1)
+                rng.shuffle(spans)
+                state = new_state(fold)
+                snapshots = []
+                for a, b in spans:
+                    assert absorb(
+                        fold, state, a, b, fold.of_batch(a, vals[a:b + 1])
+                    )
+                    snapshots.append(
+                        (covered_span(state),
+                         fold.encode(state["acc"]))
+                    )
+                covs = [c for c, _p in snapshots]
+                assert covs == sorted(covs) and len(set(covs)) == len(covs)
+                assert covs[-1] == n
+                for _c, payload in snapshots:
+                    enc = fold.encode(fold.decode(payload))
+                    assert enc == payload
+                whole = new_state(fold)
+                absorb(fold, whole, 0, n - 1, fold.of_batch(0, vals))
+                if fold.name == "fmatch":
+                    # probes are schedule-relative; the decided
+                    # (index, value) is the claim that must agree
+                    assert state["acc"][:2] == whole["acc"][:2]
+                else:
+                    assert state["acc"] == whole["acc"], fold.name
+
+    def test_duplicate_and_replayed_emits_never_regress(self):
+        """The client contract (client.submit docstring): gate on
+        ``covered`` only. A redelivered Emit, or a replayed incarnation
+        re-emitting its whole prefix with seq reset to 0, renders no
+        regression — and at the fold layer the duplicate span is a
+        coverage-gated no-op."""
+        rng = random.Random(0xD0B1)
+        for trial in range(10):
+            seed = rng.randrange(1 << 32)
+            n = rng.randint(24, len(self.ENTRIES))
+            vals = _scores(seed, self.ENTRIES[:n])
+            fold = FSum()  # non-idempotent: regressions would corrupt
+            spans = _random_partition(rng, 0, n - 1)
+            rng.shuffle(spans)
+            state = new_state(fold)
+            emits = []
+            for seq, (a, b) in enumerate(spans):
+                acc = fold.of_batch(a, vals[a:b + 1])
+                assert absorb(fold, state, a, b, acc)
+                # the duplicate delivery is a no-op: same acc, state kept
+                before = (list(state["covered"]), list(state["acc"]))
+                assert not absorb(fold, state, a, b, acc)
+                assert (list(state["covered"]), list(state["acc"])) == (
+                    before
+                )
+                emits.append(Emit(
+                    job_id=1, seq=seq, covered=covered_span(state),
+                    total=n, payload=fold.encode(state["acc"]),
+                ))
+            # wire schedule: duplicates injected, then a failover replay
+            # of a prefix with seq restarting from zero
+            schedule = list(emits)
+            for dup in rng.sample(emits, min(3, len(emits))):
+                schedule.insert(rng.randint(0, len(schedule)), dup)
+            cut = rng.randint(1, len(emits))
+            for i, e in enumerate(emits[:cut]):
+                schedule.append(Emit(
+                    job_id=1, seq=i, covered=e.covered, total=e.total,
+                    payload=e.payload,
+                ))
+            rendered = []
+            seen = -1
+            for e in schedule:
+                if e.covered <= seen:
+                    continue
+                seen = e.covered
+                rendered.append((e.covered, bytes(e.payload)))
+            covs = [c for c, _p in rendered]
+            assert covs == sorted(covs) and len(set(covs)) == len(covs)
+            assert covs[-1] == n
+            assert rendered[-1][1] == fold.encode(
+                [sum(vals), len(vals)]
+            )
+
+    def test_wal_segment_merges_compose_with_partial_states(self):
+        """journal.merge_states' per-job rule on dict folds: disjoint
+        segment states union; overlapping NON-idempotent states keep
+        the richer side instead of double-counting."""
+        seed, n = 0x5EC5, 60
+        vals = _scores(seed, self.ENTRIES[:n])
+        for fold in (FMin(), FSum()):
+            a = new_state(fold)
+            absorb(fold, a, 0, 29, fold.of_batch(0, vals[:30]))
+            b = new_state(fold)
+            absorb(fold, b, 30, n - 1, fold.of_batch(30, vals[30:]))
+            merged = merge_states(fold, a, b)
+            assert covered_span(merged) == n
+            whole = new_state(fold)
+            absorb(fold, whole, 0, n - 1, fold.of_batch(0, vals))
+            assert merged["acc"] == whole["acc"], fold.name
+        # overlapping fsum segments: conservative richer-side pick
+        fold = FSum()
+        rich = new_state(fold)
+        absorb(fold, rich, 0, 39, fold.of_batch(0, vals[:40]))
+        poor = new_state(fold)
+        absorb(fold, poor, 20, 29, fold.of_batch(20, vals[20:30]))
+        merged = merge_states(fold, poor, rich)
+        assert merged == rich  # never summed twice over [20, 29]
+
+
+# ---------------------------------------------------------------------------
+# the weighted-fair park queue, driven at the unit level (no loop: the
+# ticker no-ops by design and the drives call _drain_parked directly)
+# ---------------------------------------------------------------------------
+
+class _StubServer:
+    def __init__(self, conn_ids=()):
+        self.conn_ids = set(conn_ids)
+        self.writes = []
+
+    def write(self, conn_id, data):
+        self.writes.append((conn_id, bytes(data)))
+
+
+def _mine_req(job_id, ckey=""):
+    return Request(job_id=job_id, mode=PowMode.MIN, lower=0, upper=31,
+                   data=b"park-%d" % job_id, client_key=ckey)
+
+
+_DICT_DATA = ds.pack_params("fmin", 0xFA1A, [b"pa", b"pb", b"pc"])
+
+
+def _dict_req(job_id, ckey=""):
+    return Request(job_id=job_id, mode=PowMode.MIN, lower=0, upper=2,
+                   data=_DICT_DATA, client_key=ckey, workload="dict")
+
+
+def _park_coord(**kw):
+    kw.setdefault("max_jobs", 1)
+    kw.setdefault("park_capacity", 32)
+    kw.setdefault("retry_after_ms", 50)
+    server = _StubServer({1, 2})
+    coord = Coordinator(server, **kw)
+    # one live job fills the table so every new submission parks
+    coord._mint_job(1, _mine_req(900))
+    return coord, server
+
+
+class TestParkStride:
+    def test_stride_drain_tracks_the_weight_split(self):
+        coord, _server = _park_coord(
+            workload_weights={"mine": 3.0, "dict": 1.0}
+        )
+        for i in range(12):
+            coord._on_request(1, _mine_req(i + 1))
+            coord._on_request(2, _dict_req(i + 101))
+        assert coord.stats["jobs_parked"] == 24
+        assert len(coord._jobs) == 1  # nothing minted while parked
+        # free one slot at a time — the degenerate schedule a
+        # quantum-per-round DRR loses: stride must still split 3:1
+        order = []
+        for _ in range(8):
+            coord._max_jobs = len(coord._jobs) + 1
+            before = dict(coord.parked_drained_by_class)
+            coord._drain_parked()
+            after = coord.parked_drained_by_class
+            (cls,) = [
+                c for c in after
+                if after[c] != before.get(c, 0)
+            ]
+            order.append(cls)
+        assert order == [
+            "dict", "mine", "mine", "mine",
+            "dict", "mine", "mine", "mine",
+        ]
+        assert coord.parked_drained_by_class == {"mine": 6, "dict": 2}
+        # admitted parked entries took the normal mint path
+        minted = [
+            j.request.workload or "mine"
+            for j in coord._jobs.values()
+        ][1:]
+        assert minted.count("mine") == 6 and minted.count("dict") == 2
+
+    def test_overflow_lru_sheds_oldest_with_explicit_refuse(self):
+        coord, server = _park_coord(park_capacity=2)
+        for jid in (11, 12, 13):
+            coord._on_request(2, _dict_req(jid, ckey="flood"))
+        assert coord.stats["jobs_parked"] == 3
+        assert coord.stats["parked_shed"] == 1
+        assert len(coord._parked["dict"]) == 2
+        # the shed entry was the OLDEST and got a Refuse with the
+        # retry hint — explicit backpressure, never a silent drop
+        refusals = [decode_msg(d) for _c, d in server.writes]
+        refusals = [m for m in refusals if isinstance(m, Refuse)]
+        assert [m.job_id for m in refusals] == [11]
+        assert refusals[0].retry_after_ms == 50
+        # parked entries are invisible to exactly-once state: nothing
+        # journaled, nothing bound, no job minted
+        assert len(coord._jobs) == 1
+        assert coord._bound == {}
+
+    def test_dead_and_superseded_entries_drop_without_minting(self):
+        coord, _server = _park_coord()
+        coord._on_request(99, _mine_req(5))        # conn 99 is dead
+        coord._on_request(2, _mine_req(6, ckey="k"))
+        coord._bound[("k", 6)] = 777  # superseded while parked
+        coord._max_jobs = 10
+        coord._drain_parked()
+        assert coord.stats["parked_drained"] == 0
+        assert len(coord._jobs) == 1
+        assert coord._parked == {}  # both entries dropped, queue gone
+
+    def test_late_class_joins_at_the_current_virtual_time(self):
+        coord, _server = _park_coord(
+            workload_weights={"mine": 1.0, "dict": 1.0}
+        )
+        for i in range(4):
+            coord._on_request(1, _mine_req(i + 1))
+        for _ in range(2):
+            coord._max_jobs = len(coord._jobs) + 1
+            coord._drain_parked()
+        assert coord._park_deficit["mine"] == pytest.approx(2.0)
+        # a class parking NOW starts at the live virtual time — not at
+        # zero, which would let it lap the backlogged class
+        coord._on_request(2, _dict_req(50))
+        assert coord._park_deficit["dict"] == pytest.approx(2.0)
+
+    def test_full_table_with_park_armed_never_line_jump_sheds(self):
+        coord, _server = _park_coord()
+        shed_before = coord.stats["jobs_shed"]
+        coord._on_request(1, _mine_req(41))
+        # the pending seed job was NOT LRU-evicted to admit the
+        # newcomer: with the park queue armed, arrivals wait their turn
+        assert coord.stats["jobs_shed"] == shed_before
+        assert coord.stats["jobs_parked"] == 1
+        assert 900 in {
+            j.client_job_id for j in coord._jobs.values()
+        }
+
+
+# ---------------------------------------------------------------------------
+# real-fleet e2e: dict jobs over CpuMiners
+# ---------------------------------------------------------------------------
+
+class _Fleet:
+    def __init__(self, coord):
+        self.coord = coord
+        self.serve = asyncio.ensure_future(coord.serve())
+        self.miners = []
+
+    @classmethod
+    async def create(cls, n_miners=2, **kw):
+        kw.setdefault("params", FAST)
+        coord = await Coordinator.create(**kw)
+        self = cls(coord)
+        for _ in range(n_miners):
+            self.miners.append(asyncio.ensure_future(run_miner(
+                "127.0.0.1", coord.port, CpuMiner(), params=FAST,
+            )))
+        await asyncio.sleep(0.05)  # let the Joins land
+        return self
+
+    async def close(self):
+        for t in self.miners:
+            t.cancel()
+        self.serve.cancel()
+        await asyncio.gather(
+            *self.miners, self.serve, return_exceptions=True
+        )
+        await self.coord.close()
+
+
+def test_dict_job_end_to_end_exactly_once():
+    async def scenario():
+        fleet = await _Fleet.create(n_miners=2, chunk_size=64)
+        try:
+            cands = [b"pw-%04d" % i for i in range(300)]
+            req = _dreq("fmin", 0xD1C7, cands, ckey="fabric-e2e")
+            res = await submit(
+                "127.0.0.1", fleet.coord.port, req, params=FAST
+            )
+            vals = _scores(0xD1C7, cands)
+            want = min((v, i) for i, v in enumerate(vals))
+            assert fold_of(req).decode(bytes(res.payload)) == list(want)
+            # a duplicate submission under the same (ckey, cjid) is
+            # answered from the winners table — nothing re-minted
+            next_id = fleet.coord._next_job_id
+            res2 = await submit(
+                "127.0.0.1", fleet.coord.port, req, params=FAST
+            )
+            assert bytes(res2.payload) == bytes(res.payload)
+            assert fleet.coord._next_job_id == next_id
+        finally:
+            await fleet.close()
+
+    run(scenario())
+
+
+def test_dict_streaming_partials_exact_under_chaos():
+    """A streaming fsum (NON-idempotent: any double-settle corrupts the
+    answer) through a dup/reorder/delay FaultPlan on the coordinator's
+    socket: the final sum is exact, >= 3 partials arrive, each partial's
+    decoded count equals its claimed coverage, and gated coverage never
+    regresses."""
+    async def scenario():
+        fleet = await _Fleet.create(
+            n_miners=2, chunk_size=16, emit_interval=0.0
+        )
+        try:
+            plan = FaultPlan(11).link(
+                peer="*", dup=0.25, reorder=0.2, reorder_delay=0.01,
+                delay=0.002, delay_jitter=0.003,
+            )
+            for ep in loadgen._endpoints(fleet.coord):
+                ep.set_fault_plan(plan)
+            cands = [b"chaos-%04d" % i for i in range(600)]
+            req = _dreq(
+                "fsum", 0xFA57, cands, ckey="fabric-chaos", stream=True
+            )
+            partials = []
+            res = await submit(
+                "127.0.0.1", fleet.coord.port, req, params=FAST,
+                on_emit=lambda e: partials.append(
+                    (e.covered, e.total, bytes(e.payload))
+                ),
+            )
+            vals = _scores(0xFA57, cands)
+            fold = fold_of(req)
+            assert fold.decode(bytes(res.payload)) == [sum(vals), 600]
+            assert len(partials) >= 3
+            assert fleet.coord.stats["emits_sent"] >= 3
+            gated = []
+            for cov, total, payload in partials:
+                assert total == 600
+                _s, count = fold.decode(payload)
+                assert count == cov  # the payload matches its coverage
+                if not gated or cov > gated[-1]:
+                    gated.append(cov)
+            assert len(gated) >= 3
+            assert gated == sorted(gated)
+        finally:
+            await fleet.close()
+
+    run(scenario())
+
+
+def test_dict_windowed_dispatch_recombines_exactly():
+    """An over-budget catalog (> WINDOW_BYTES) dispatches as per-chunk
+    windowed Setups; the re-based windows must recombine to the exact
+    global top-k, with >= 2 partials proving the job really split."""
+    async def scenario():
+        fleet = await _Fleet.create(
+            n_miners=2, chunk_size=4096, emit_interval=0.0
+        )
+        try:
+            cands = [b"window-%06d" % i for i in range(2600)]
+            req = _dreq(
+                "topk", 0x3157, cands, k=3, ckey="fabric-window",
+                stream=True,
+            )
+            assert len(req.data) > ds.WINDOW_BYTES
+            partials = []
+            res = await submit(
+                "127.0.0.1", fleet.coord.port, req, params=FAST,
+                on_emit=lambda e: partials.append(e.covered),
+            )
+            pairs = sorted(
+                (v, i) for i, v in enumerate(_scores(0x3157, cands))
+            )
+            got = fold_of(req).decode(bytes(res.payload))
+            assert [tuple(p) for p in got] == pairs[:3]
+            # >= 1 strict-partial emit proves the job really split into
+            # windowed chunks (the LAST settle yields the final Result,
+            # not an Emit)
+            assert partials and max(partials) < 2600
+        finally:
+            await fleet.close()
+
+    run(scenario())
+
+
+# ---------------------------------------------------------------------------
+# the fleet drill gates (tier-1): loadgen --scenario stream|starve|soak
+# ---------------------------------------------------------------------------
+
+def test_loadgen_stream_scenario_smoke(capsys):
+    """The streaming gate: >= 3 monotone partials before the exact
+    final answer, a kill -9 mid-stream, partials that keep flowing from
+    the REPLAYED incarnation, and a final payload bit-identical to the
+    non-streaming submission's."""
+    rc = loadgen.main(["--scenario", "stream", "--smoke", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0, f"stream gate failed: {out}"
+    metrics = _json.loads(out.splitlines()[0])
+    assert metrics["partials"] >= 3
+    assert metrics["monotone"] is True
+    assert metrics["crashed_mid_stream"] is True
+    assert metrics["partials_post_crash"] >= 1
+    assert metrics["emits_post_crash"] >= 1
+    assert metrics["final_exact"] is True
+    assert metrics["bit_identical_final"] is True
+    assert (
+        0
+        < metrics["time_to_first_partial_ms"]
+        < metrics["time_to_final_ms"]
+    )
+
+
+def test_loadgen_starve_scenario_smoke(capsys):
+    """The starvation gate: a greedy dict flood against background
+    mining tenants on one coordinator — the flood demonstrably parks
+    and overflows the bounded queue, the mining p99 stays within the
+    2x bar, and weight-normalized drain counts track the configured
+    DRR share."""
+    rc = loadgen.main([
+        "--scenario", "starve", "--duration", "1.5",
+        "--smoke", "--json",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, f"starve gate failed: {out}"
+    metrics = _json.loads(out.splitlines()[0])
+    flood = metrics["flood"]
+    assert flood["jobs_parked"] > 0
+    assert flood["parked_shed"] > 0
+    assert flood["park_queue_high_water"] <= 2 * metrics["park_capacity"]
+    assert metrics["baseline"]["mining_jobs"] > 0
+    assert flood["mining_jobs"] > 0
+    assert 1 / 3 <= metrics["drr_fairness_ratio"] <= 3.0
+
+
+def test_loadgen_soak_scenario_smoke(capsys):
+    """The soak gate: steady mixed load (mining + dict + churn + a park
+    pulse) with live compaction — ZERO second-half growth in every
+    ``*_high_water`` gauge, a WAL bounded by compaction, and the
+    exactly-once ledgers clean."""
+    rc = loadgen.main([
+        "--scenario", "soak", "--duration", "3", "--smoke", "--json",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, f"soak gate failed: {out}"
+    metrics = _json.loads(out.splitlines()[0])
+    assert metrics["hw_growth"] == {}
+    assert metrics["journal"]["compactions"] >= 1
+    assert metrics["wal_end_bytes"] <= 4 * metrics["compact_bytes"]
+    assert metrics["mining_answered"] > 0
+    assert metrics["dict_answered"] > 0
+    assert metrics["churn_done"] > 0
+    assert metrics["jobs_parked"] > 0
+    assert metrics["answers_duplicated"] == 0
+    assert metrics["answers_wrong"] == 0
+    assert metrics["poisoned_answers"] == 0
+
+
+@pytest.mark.slow
+def test_loadgen_soak_scenario_full(capsys):
+    """The full-length soak (same gates, 8s+ of steady state) — the
+    long-haul leak hunt tier-1 runs in miniature above."""
+    rc = loadgen.main(["--scenario", "soak", "--duration", "8", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0, f"full soak gate failed: {out}"
+    metrics = _json.loads(out.splitlines()[0])
+    assert metrics["hw_growth"] == {}
+    assert metrics["journal"]["compactions"] >= 1

@@ -399,6 +399,7 @@ class ClockSkewPlan:
     ):
         if not 0.0 <= drift < 1.0:
             raise ValueError("drift must be in [0, 1)")
+        self._seed = seed
         self._rng = random.Random(seed)
         self._drift = drift
         self._max_step = max_step_s
@@ -439,3 +440,17 @@ class ClockSkewPlan:
         # ride the same skewed base so wall and monotonic drift
         # together, then add the step offset only wall clocks suffer
         return self._advance() + self._wall_offset
+
+    def fork(self, salt: int) -> "ClockSkewPlan":
+        """An independently-seeded sibling plan with the same knobs
+        (ISSUE 20): one chaos cell skews BOTH ends of a conversation —
+        the coordinator gets this plan, each worker a ``fork(i)`` —
+        and because the streams are decorrelated the two sides disagree
+        about how fast time passes, not just about its value. Same
+        ``(seed, salt)`` → same sibling, so cells stay reproducible."""
+        return ClockSkewPlan(
+            (self._seed * 0x9E3779B1 + salt * 0x85EBCA77) & 0xFFFFFFFF,
+            drift=self._drift,
+            max_step_s=self._max_step,
+            segment_s=self._segment,
+        )

@@ -26,6 +26,7 @@ from tpuminter.lsp import (
 )
 from tpuminter.lsp.params import FAST, jittered_backoff
 from tpuminter.protocol import (
+    Emit,
     PowMode,
     Refuse,
     Request,
@@ -58,8 +59,17 @@ async def submit(
     max_backoff: float = 5.0,
     rng: Optional[random.Random] = None,
     addrs: Optional[list] = None,
+    on_emit=None,
 ) -> Result:
     """Connect, submit ``request``, and await its final Result.
+
+    ``on_emit`` (ISSUE 20) receives each streaming :class:`Emit`
+    partial pushed for this job when the request was submitted with
+    ``stream=True`` — an advisory running answer + coverage off
+    journal-settled state only. The callback should gate on
+    ``emit.covered`` monotonicity (this function does not): sequence
+    numbers restart across a coordinator failover, coverage never
+    regresses.
 
     Raises :class:`LspConnectionLost` if the coordinator dies first (the
     caller prints ``Disconnected``, matching the reference UX) — unless
@@ -110,6 +120,10 @@ async def submit(
                     and msg.job_id == request.job_id
                 ):
                     return msg
+                if isinstance(msg, Emit) and msg.job_id == request.job_id:
+                    if on_emit is not None:
+                        on_emit(msg)
+                    continue
                 if (
                     isinstance(msg, Refuse)
                     and msg.retry_after_ms > 0
@@ -237,9 +251,25 @@ def main(argv: Optional[list] = None) -> None:
                         help="with --workload: raw params frame bytes "
                         "(overrides the hashcore convenience flags — the "
                         "escape hatch for other registered workloads)")
+    parser.add_argument("--candidates", metavar="FILE", default=None,
+                        help="with --workload dict: newline-separated "
+                        "candidate file packed through the dict params "
+                        "codec (ISSUE 20); the search domain becomes "
+                        "indices into the shipped list")
+    parser.add_argument("--stream", action="store_true",
+                        help="ask for streaming partial results (ISSUE "
+                        "20): the coordinator pushes journal-settled "
+                        "Emit partials (running answer + coverage) "
+                        "before the final Result; each prints as "
+                        "'Partial ...'")
     args = parser.parse_args(argv)
     if args.timeout is not None and args.timeout <= 0:
         parser.error("--timeout must be positive seconds")
+    if args.stream and args.workload is None:
+        parser.error(
+            "--stream needs --workload: only registered-workload folds "
+            "emit partial results"
+        )
     from tpuminter.replication import parse_addr_list
 
     if args.coordinator is not None:
@@ -281,6 +311,7 @@ def main(argv: Optional[list] = None) -> None:
     if args.workload is not None:
         if args.header is not None:
             parser.error("--workload conflicts with --header")
+        upper = args.max_nonce_opt
         if args.params is not None:
             data = _hex(args.params, "--params")
         elif args.workload == "hashcore":
@@ -292,18 +323,38 @@ def main(argv: Optional[list] = None) -> None:
                 )
             except ValueError as exc:
                 parser.error(str(exc))
+        elif args.workload == "dict":
+            if args.candidates is None:
+                parser.error(
+                    "--workload dict needs --candidates FILE (or raw "
+                    "--params HEX)"
+                )
+            from tpuminter.workloads import dictsearch as _ds
+
+            with open(args.candidates, "rb") as fh:
+                cands = [ln for ln in fh.read().splitlines() if ln]
+            try:
+                data = _ds.pack_params(
+                    args.variant, args.seed, cands,
+                    threshold=args.threshold, k=args.k,
+                )
+            except ValueError as exc:
+                parser.error(str(exc))
+            # an opaque domain: the job sweeps indices INTO the list
+            upper = len(cands) - 1
         else:
             parser.error(
                 f"--workload {args.workload}: pass --params HEX (only "
-                "hashcore's params have convenience flags)"
+                "hashcore and dict params have convenience flags)"
             )
         request = Request(
             job_id=1,
             mode=PowMode.MIN,
             lower=0,
-            upper=args.max_nonce_opt,
+            upper=upper,
             data=data,
             workload=args.workload,
+            stream=args.stream,
         )
     elif args.header is not None:
         header = _hex(args.header, "--header")
@@ -358,6 +409,33 @@ def main(argv: Optional[list] = None) -> None:
     else:
         parser.error("need either <message> <maxNonce> or --header")
 
+    on_emit = None
+    if args.stream:
+        from tpuminter import workloads as _wl
+
+        stream_fold = _wl.fold_of(request)
+        seen = {"cov": -1}
+
+        def on_emit(emit):
+            # coverage-gated rendering: a duplicate or replayed Emit
+            # (redial, coordinator failover) never prints a regression
+            if emit.covered <= seen["cov"]:
+                return
+            seen["cov"] = emit.covered
+            frac = emit.covered / emit.total if emit.total else 0.0
+            desc = bytes(emit.payload).hex()
+            if stream_fold is not None:
+                try:
+                    desc = stream_fold.describe(
+                        stream_fold.decode(bytes(emit.payload))
+                    )
+                except ValueError:
+                    desc = f"undecodable payload={desc}"
+            print(
+                f"Partial [{emit.covered}/{emit.total} {frac:.0%}] {desc}",
+                flush=True,
+            )
+
     async def _run() -> int:
         try:
             # wait_for(None) imposes no deadline — the reference's
@@ -368,6 +446,7 @@ def main(argv: Optional[list] = None) -> None:
                     client_key=args.client_key,
                     reconnect=args.reconnect,
                     addrs=addrs,
+                    on_emit=on_emit,
                 ),
                 args.timeout,
             )

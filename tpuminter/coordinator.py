@@ -60,6 +60,7 @@ from tpuminter.protocol import (
     Assign,
     Beacon,
     Cancel,
+    Emit,
     Join,
     PowMode,
     ProtocolError,
@@ -350,6 +351,16 @@ class _Job:
     #: an aggregator is the value at dispatch time, and a Beacon
     #: echoing any other value is a fenced-off loser's
     lease_epoch: int = 0
+    #: streaming partial emission (ISSUE 20; workload jobs with
+    #: Request.stream only): next Emit sequence number, the settled
+    #: span already pushed (the monotone floor — an Emit never shows
+    #: less coverage than the client has seen), the monotonic instant
+    #: of the last push, and the newest DURABLY-settled snapshot
+    #: waiting out the pacing interval as (covered, total, payload)
+    emit_seq: int = 0
+    emit_covered: int = 0
+    emit_last: float = 0.0
+    emit_snapshot: Optional[Tuple[int, int, bytes]] = None
 
     @property
     def workload(self) -> str:
@@ -427,6 +438,9 @@ class Coordinator:
         unbound_ttl: float = 0.0,
         roll_budget: int = 0,
         steal_after: Optional[float] = None,
+        workload_weights: Optional[Dict[str, float]] = None,
+        park_capacity: int = 0,
+        emit_interval: float = 0.5,
         seam=None,
         clock=None,
     ):
@@ -496,6 +510,39 @@ class Coordinator:
         #: recovery (it DROPS each open lease; see federation.lease).
         #: Empty forever on a non-aggregator coordinator.
         self.recovered_leases: Dict[int, dict] = {}
+        # -- compute fabric (ISSUE 20) --------------------------------
+        if park_capacity < 0:
+            raise ValueError("park_capacity must be >= 0")
+        if emit_interval < 0:
+            raise ValueError("emit_interval must be >= 0 seconds")
+        #: per-workload-class DRR weights for draining the park queue
+        #: ("mine" is the classic mining class; unlisted classes weigh
+        #: 1.0). Weights shape DRAIN order only — per-ckey quota and
+        #: the job cap are unchanged.
+        self._workload_weights = {
+            str(k): float(v) for k, v in (workload_weights or {}).items()
+        }
+        if any(w <= 0 for w in self._workload_weights.values()):
+            raise ValueError("workload weights must be positive")
+        #: bounded park depth PER workload class; 0 (default) keeps the
+        #: refuse-only admission dialect exactly. Over-quota
+        #: submissions park here instead of bouncing; overflow
+        #: LRU-sheds the OLDEST parked entry with an explicit Refuse.
+        #: Parked entries are never journaled and mint nothing — a
+        #: crash simply loses them, and the client's existing Refuse
+        #: retry covers the gap.
+        self._park_capacity = park_capacity
+        #: workload class → parked (conn_id, Request) FIFO
+        self._parked: Dict[str, Deque[Tuple[int, Request]]] = {}
+        #: workload class → DRR deficit (credited ∝ weight per round)
+        self._park_deficit: Dict[str, float] = {}
+        #: workload class → entries drained (the starvation gate's
+        #: fairness probe: drain counts must track weight share)
+        self.parked_drained_by_class: Dict[str, int] = {}
+        self._park_task: Optional[asyncio.Task] = None
+        #: seconds between Emit pushes per streaming job (0 = push on
+        #: every durable settle — the deterministic test setting)
+        self._emit_interval = emit_interval
         # -- admission & fairness (ISSUE 13) --------------------------
         if quota_rate < 0 or quota_burst < 1:
             raise ValueError("quota_rate must be >= 0, quota_burst >= 1")
@@ -736,6 +783,15 @@ class Coordinator:
             "seam_rebinds_honored": 0,
             "seam_rebind_misses": 0,
             "quota_foreign_debits": 0,
+            #: compute fabric (ISSUE 20): park-queue motion (parked at
+            #: admission, LRU-shed at overflow, drained by weighted
+            #: DRR back through admission) and streaming Emit partials
+            #: pushed to bound clients off durable settles
+            "jobs_parked": 0,
+            "parked_shed": 0,
+            "parked_drained": 0,
+            "park_queue_high_water": 0,
+            "emits_sent": 0,
         }
         # TPUMINTER_LOOP_AFFINITY=1: the coordinator is single-loop by
         # contract (one per shard in multiloop); any mutation arriving
@@ -772,6 +828,9 @@ class Coordinator:
         unbound_ttl: float = 0.0,
         roll_budget: int = 0,
         steal_after: Optional[float] = None,
+        workload_weights: Optional[Dict[str, float]] = None,
+        park_capacity: int = 0,
+        emit_interval: float = 0.5,
         seam=None,
         clock=None,
     ) -> "Coordinator":
@@ -808,6 +867,8 @@ class Coordinator:
             retry_after_ms=retry_after_ms, winners_cap=winners_cap,
             winners_ttl=winners_ttl, unbound_ttl=unbound_ttl,
             roll_budget=roll_budget, steal_after=steal_after,
+            workload_weights=workload_weights, park_capacity=park_capacity,
+            emit_interval=emit_interval,
             seam=seam, clock=clock,
         )
         if recovered is not None:
@@ -957,7 +1018,8 @@ class Coordinator:
             self._journal.append(kind, obj, on_durable=on_durable)
 
     def _journal_settle(
-        self, job: _Job, lo: int, hi: int, msg: Result, searched: int
+        self, job: _Job, lo: int, hi: int, msg: Result, searched: int,
+        on_durable=None,
     ) -> None:
         if self._journal is None:
             return
@@ -965,11 +1027,13 @@ class Coordinator:
             # workload settle (ISSUE 15): interval subtraction replays
             # exactly like a mining settle, and the payload hex rides
             # along so recovery re-absorbs the partial through the
-            # coverage gate (journal.RecoveredState's "wp" branch)
+            # coverage gate (journal.RecoveredState's "wp" branch).
+            # ``on_durable`` is the streaming-Emit gate (ISSUE 20):
+            # a partial is only ever pushed off a FSYNCED settle.
             self._journal.append("settle", {
                 "id": job.job_id, "lo": lo, "hi": hi, "s": searched,
                 "wp": bytes(msg.payload).hex(),
-            })
+            }, on_durable=on_durable)
             return
         # the journal's highest-rate record (one per accepted chunk):
         # the same struct-packed discipline as the wire's binary Result
@@ -1151,6 +1215,8 @@ class Coordinator:
             rate_ticker.cancel()
             if ticker is not None:
                 ticker.cancel()
+            if self._park_task is not None:
+                self._park_task.cancel()
 
     def _fence_self(self) -> None:
         """A shipping lane learned (via the promoted standby's RepHello
@@ -1520,7 +1586,11 @@ class Coordinator:
         re-binds are never charged — they mint no work). Returns 0 to
         admit, else the retry_after_ms to Refuse with."""
         if self._max_jobs and len(self._jobs) >= self._max_jobs:
-            if not self._shed_one():
+            # with the park queue armed the newcomer WAITS ITS TURN —
+            # shedding a pending job to line-jump would let an open-loop
+            # flood evict its way past the DRR drain order (ISSUE 20);
+            # parkless coordinators keep the shed-one-pending behavior
+            if self._park_capacity > 0 or not self._shed_one():
                 # full of jobs that are all making progress: nothing
                 # shedable, the newcomer waits
                 return self._retry_after_ms
@@ -1720,6 +1790,12 @@ class Coordinator:
         self._reap_unbound()
         retry_ms = self._admit(conn_id, msg)
         if retry_ms:
+            if self._park_capacity > 0:
+                # weighted-fair park queue (ISSUE 20): hold the
+                # over-quota submission instead of bouncing it — the
+                # DRR drain re-admits it as capacity frees
+                self._park_submission(conn_id, msg)
+                return
             self.stats["refused_admission"] += 1
             log.info(
                 "refused admission for client %d job %d (retry in %d ms)",
@@ -1727,6 +1803,14 @@ class Coordinator:
             )
             self._send_refuse(conn_id, msg.job_id, retry_ms)
             return
+        self._mint_job(conn_id, msg)
+
+    def _mint_job(self, conn_id: int, msg: Request) -> None:
+        """Resolve the workload discipline and mint the job — the tail
+        of ``_on_request``, shared with the park queue's DRR drain (an
+        admitted parked submission takes exactly the fresh-submission
+        path from here on: same journal record, same bind, same
+        dispatch scheduling)."""
         discipline = None
         if msg.workload:
             # resolve the fold discipline NOW (ISSUE 15): an unknown
@@ -1791,6 +1875,122 @@ class Coordinator:
         log.info(
             "client %d re-bound to running job %d", conn_id, job.job_id
         )
+
+    # -- weighted-fair park queue (ISSUE 20) -----------------------------
+
+    @staticmethod
+    def _park_class(msg: Request) -> str:
+        """DRR scheduling class of a submission: its workload name, or
+        ``"mine"`` for classic mining jobs."""
+        return msg.workload or "mine"
+
+    def _park_submission(self, conn_id: int, msg: Request) -> None:
+        """Park an over-quota submission (``park_capacity > 0``):
+        bounded per-class FIFO, oldest LRU-shed with an explicit
+        Refuse at overflow. Nothing is journaled or minted — a parked
+        entry is invisible to exactly-once until the DRR drain
+        re-admits it through the normal path."""
+        cls = self._park_class(msg)
+        q = self._parked.get(cls)
+        if q is None:
+            q = self._parked[cls] = deque()
+            if self._park_deficit:
+                # a class joining the backlog starts at the current
+                # virtual time (the lowest live pass) — starting at
+                # zero would let a class that drains and re-parks lap
+                # the persistently backlogged ones
+                self._park_deficit.setdefault(
+                    cls, min(self._park_deficit.values())
+                )
+        if len(q) >= self._park_capacity:
+            old_conn, old_msg = q.popleft()
+            self.stats["parked_shed"] += 1
+            self._send_refuse(
+                old_conn, old_msg.job_id, self._retry_after_ms
+            )
+        q.append((conn_id, msg))
+        self.stats["jobs_parked"] += 1
+        self._hw(
+            "park_queue_high_water",
+            sum(len(d) for d in self._parked.values()),
+        )
+        self._ensure_park_ticker()
+
+    def _ensure_park_ticker(self) -> None:
+        if self._park_task is not None and not self._park_task.done():
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # unit-level drives call _drain_parked() directly
+        self._park_task = loop.create_task(self._park_ticker())
+
+    async def _park_ticker(self) -> None:
+        """Drain cadence for the park queue: a short fixed period —
+        quota tokens accrue continuously, so polling beats predicting
+        each class's exact accrual instant. Self-terminating once the
+        queues empty (re-armed by the next park)."""
+        period = max(0.02, min(0.25, self._retry_after_ms / 2000.0))
+        while any(self._parked.values()):
+            await asyncio.sleep(period)
+            self._drain_parked()
+
+    def _drain_parked(self) -> None:
+        """Weighted-fair drain of the park queues — stride scheduling:
+        each class carries a virtual pass (``drains / weight``), and
+        every admission goes to the backlogged class with the LOWEST
+        pass, so admitted counts track the configured weights exactly
+        even though slots free one at a time (a quantum-per-round DRR
+        degenerates there: whichever class is visited first wins every
+        single slot). A class whose queue head is refused admission
+        (its identity still over quota, or the table refilled) sits
+        out the rest of this drain while the others keep going — the
+        starvation gate's guarantee that a greedy flood cannot bury a
+        light tenant's parked submissions."""
+        alive = set(self._server.conn_ids)
+        blocked: set = set()
+        while True:
+            ready = [
+                (self._park_deficit.get(c, 0.0), c)
+                for c, q in self._parked.items()
+                if q and c not in blocked
+            ]
+            if not ready:
+                break
+            _, cls = min(ready)
+            q = self._parked[cls]
+            conn_id, msg = q[0]
+            if conn_id not in alive:
+                # parked client died: drop the entry — its Refuse
+                # retry path re-submits on the new connection
+                q.popleft()
+                continue
+            if msg.client_key:
+                key = (msg.client_key, msg.job_id)
+                if key in self._winners or key in self._bound:
+                    # superseded while parked: the client's
+                    # re-submission already minted (or finished)
+                    # this (ckey, cjid) — minting again would
+                    # double-mine and risk a duplicate answer
+                    q.popleft()
+                    continue
+            if self._admit(conn_id, msg):
+                blocked.add(cls)
+                continue
+            q.popleft()
+            w = self._workload_weights.get(cls, 1.0)
+            self._park_deficit[cls] = (
+                self._park_deficit.get(cls, 0.0) + 1.0 / max(w, 1e-9)
+            )
+            self.stats["parked_drained"] += 1
+            self.parked_drained_by_class[cls] = (
+                self.parked_drained_by_class.get(cls, 0) + 1
+            )
+            self._mint_job(conn_id, msg)
+        for cls in list(self._parked):
+            if not self._parked[cls]:
+                del self._parked[cls]
+                self._park_deficit.pop(cls, None)
 
     # -- cross-process shard seam (ISSUE 19) -----------------------------
 
@@ -2214,11 +2414,70 @@ class Coordinator:
             self._requeue_chunk(job, lo, hi)
             return
         if job.wfold(lo, hi, acc):
-            self._journal_settle(job, lo, hi, msg, searched)
+            on_durable = None
+            if job.request.stream:
+                # streaming snapshot (ISSUE 20): capture the fold NOW
+                # — settled span, domain total, encoded accumulator —
+                # and release it only once THIS settle record is
+                # fsynced, so an Emit never shows coverage a crash
+                # could roll back. Journal-less coordinators have no
+                # durability gap and emit directly.
+                snap = (
+                    workloads.covered_span(job.wstate),
+                    job.request.upper - job.request.lower + 1,
+                    job.discipline.encode(job.wacc),
+                )
+                if self._journal is not None:
+                    on_durable = functools.partial(
+                        self._emit_partial, job.job_id, snap
+                    )
+                else:
+                    self._emit_partial(job.job_id, snap)
+            self._journal_settle(
+                job, lo, hi, msg, searched, on_durable=on_durable
+            )
         if job.discipline.is_final(job.wacc):
             self._finish_job(job, found=True)
         else:
             self._maybe_finish_exhausted(job)
+
+    def _emit_partial(
+        self, job_id: int, snap: Tuple[int, int, bytes]
+    ) -> None:
+        """Durability callback for one streaming settle: fold the
+        snapshot into the job's pending-emission slot and push an Emit
+        when the pacing interval allows. Snapshots arrive in settle
+        order (the journal group-commits in append order), so coverage
+        is monotone; the ``emit_covered`` floor makes the stream
+        robust to reordering anyway. A snapshot at full coverage is
+        dropped — the final Result is imminent and supersedes it, as
+        it does any un-pushed trailing snapshot."""
+        job = self._jobs.get(job_id)
+        if job is None or job.done:
+            return
+        if job.emit_snapshot is None or snap[0] > job.emit_snapshot[0]:
+            job.emit_snapshot = snap
+        covered, total, payload = job.emit_snapshot
+        if covered >= total or covered <= job.emit_covered:
+            return
+        now = self._mono()
+        if self._emit_interval and now - job.emit_last < self._emit_interval:
+            return  # paced: the slot holds the newest snapshot
+        conn = job.client_conn
+        if conn == UNBOUND:
+            return  # advisory stream: a re-bound client resumes it
+        job.emit_snapshot = None
+        job.emit_last = now
+        job.emit_covered = covered
+        seq = job.emit_seq
+        job.emit_seq += 1
+        try:
+            self._server.write(conn, encode_msg(
+                Emit(job.client_job_id, seq, covered, total, payload)
+            ))
+            self.stats["emits_sent"] += 1
+        except ConnectionError:
+            pass  # client died mid-stream; partials resume on re-bind
 
     def _reject_result(
         self, conn_id: int, job: _Job, msg: Result, lo: int, hi: int
@@ -2340,7 +2599,27 @@ class Coordinator:
         extranonce-unit RollAssign the range expands from. Raises
         ConnectionError on a dead conn; the caller rolls back its own
         bookkeeping."""
-        if miner.conn_id not in job.setup_sent:
+        window = None
+        if job.discipline is not None and roll is None:
+            window = workloads.window_for(job.request, lo, hi)
+        if window is not None:
+            # opaque-domain dispatch (ISSUE 20): this job's candidate
+            # catalog is too big to ride one datagram, so EVERY chunk
+            # ships its own Setup carrying just the [lo, hi] window
+            # (re-based so entry(i) still resolves globally). The
+            # worker overwrites its cached template in order before
+            # the Assign referencing it arrives (LSP ordered
+            # delivery); ``setup_sent`` is deliberately bypassed — a
+            # cached full-catalog template never exists for windowed
+            # jobs, and the NEXT chunk needs its own window anyway.
+            self._server.write(
+                miner.conn_id,
+                encode_msg(Setup(dc_replace(
+                    job.request, job_id=job.job_id, data=window,
+                    lower=lo, upper=hi,
+                ))),
+            )
+        elif miner.conn_id not in job.setup_sent:
             # LSP's ordered delivery guarantees the worker caches the
             # Setup before any Assign referencing it arrives. Setup
             # stays JSON (the ragged long-tail path) even to binary
@@ -2791,6 +3070,21 @@ class Coordinator:
         client_jobs = self._clients.get(job.client_conn)
         if client_jobs is not None:
             client_jobs.discard(job.job_id)
+            if not client_jobs:
+                # drop the empty entry NOW: transport-level loss
+                # detection for a client that politely went away after
+                # its answer can lag by whole epochs, and a churn of
+                # short-lived clients would grow the session table by
+                # one dead entry each until then (the soak drill's
+                # sessions_high_water leak, ISSUE 20) — the next
+                # submission on a live conn just re-creates it
+                self._clients.pop(job.client_conn, None)
+        if any(self._parked.values()):
+            # event-driven DRR (ISSUE 20): a retired job frees a table
+            # slot — hand it to the parked backlog NOW, in weight
+            # order, instead of letting whichever fresh submission
+            # races in before the next ticker period claim it
+            self._drain_parked()
 
     # -- dispatch --------------------------------------------------------
 
@@ -2937,6 +3231,13 @@ class Coordinator:
             # mid-span chunk is unavoidable and exhaustion wins)
             if budget > miner.span:
                 budget -= budget % miner.span
+        if job.discipline is not None:
+            # opaque-domain clamp (ISSUE 20): windowed workloads bound
+            # the indices per dispatch so each per-chunk Setup window
+            # stays datagram-sized (0 = no bound, the common case)
+            wcap = workloads.chunk_cap(job.request)
+            if wcap:
+                budget = min(budget, wcap)
         return budget
 
     def _assign(
@@ -3274,6 +3575,27 @@ def main(argv: Optional[list] = None) -> None:
         "client that returns later simply re-mines",
     )
     parser.add_argument(
+        "--park-queue", type=int, default=0, metavar="N",
+        help="park up to N over-quota submissions PER workload class "
+        "and drain them by weighted deficit round-robin as capacity "
+        "frees, instead of refusing outright (0 = off, the refuse-"
+        "only dialect). Overflow LRU-sheds the oldest parked entry "
+        "with an explicit Refuse (README 'Compute fabric')",
+    )
+    parser.add_argument(
+        "--workload-weight", metavar="LIST", default=None,
+        help="DRR drain weights for the park queue as "
+        "NAME=W[,NAME=W...], e.g. 'mine=1,hashcore=1,dict=2' ('mine' "
+        "is the classic mining class; unlisted classes weigh 1). "
+        "Only meaningful with --park-queue",
+    )
+    parser.add_argument(
+        "--emit-interval", type=float, default=0.5, metavar="SECONDS",
+        help="pacing of streaming Emit partials per job (clients that "
+        "submit with stream=True; default 0.5, 0 = push on every "
+        "durable settle)",
+    )
+    parser.add_argument(
         "--replica-ack", action="store_true",
         help="with --replicate-to: hold each winner acknowledgement "
         "until a standby confirms the finish record, so an answered "
@@ -3299,11 +3621,22 @@ def main(argv: Optional[list] = None) -> None:
             if not name or not mult:
                 parser.error(f"--quota-tier wants NAME=MULT, got {spec!r}")
             quota_tiers[name] = float(mult)
+        weights = {}
+        for part in filter(None, (args.workload_weight or "").split(",")):
+            name, _, mult = part.partition("=")
+            if not name or not mult:
+                parser.error(
+                    "--workload-weight wants NAME=W[,NAME=W...], got "
+                    f"{part!r}"
+                )
+            weights[name] = float(mult)
         admission = dict(
             quota_rate=args.quota_rate, quota_burst=args.quota_burst,
             quota_tiers=quota_tiers, max_jobs=args.max_jobs,
             retry_after_ms=args.retry_after_ms,
             winners_ttl=args.winners_ttl, unbound_ttl=args.unbound_ttl,
+            workload_weights=weights, park_capacity=args.park_queue,
+            emit_interval=args.emit_interval,
         )
         if args.procs > 1:
             if args.loops > 1:

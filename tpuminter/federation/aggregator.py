@@ -414,9 +414,14 @@ class Aggregator:
         # the inner job: the leased sub-range under OUR durable client
         # key and the parent chunk id — the (ckey, job_id) pair the
         # inner journal plane already makes exactly-once
+        # stream=False on the inner submission (ISSUE 20): streaming
+        # composes at LEASE granularity — each finished lease is a
+        # journaled settle on the PARENT, which is what drives the
+        # parent's own Emits — so inner partial Emits would only be
+        # noise on this session's read loop, never forwarded
         req = dc_replace(
             tmpl, job_id=parent_chunk_id, lower=lower, upper=upper,
-            chunk_id=0, client_key=self._ckey,
+            chunk_id=0, client_key=self._ckey, stream=False,
         )
         self._lease_tasks[parent_chunk_id] = asyncio.ensure_future(
             self._run_lease(client, lease, req)

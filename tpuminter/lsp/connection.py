@@ -62,11 +62,15 @@ from tpuminter.lsp.params import Params
 _FINAL, _MORE = b"\x00", b"\x01"
 #: App bytes per fragment (one byte of each frame is the flag).
 FRAGMENT_SIZE = MAX_PAYLOAD - 1
-#: Reassembly bound. Honest app messages are a few kB (the largest — a
-#: mainnet rolled job — is ~2 kB); a peer streaming more-fragments past
-#: this is buggy or hostile and gets the connection declared lost, so
-#: fragmentation cannot be used to grow our memory without bound.
-MAX_MESSAGE = 1 << 20
+#: Reassembly bound. Most app messages are a few kB (the largest
+#: mining frame — a mainnet rolled job — is ~2 kB), but an
+#: opaque-domain workload Request (ISSUE 20) ships its whole candidate
+#: catalog in ``Request.data``: 100k entries at the dictsearch entry
+#: cap is ~3.2 MiB, so the bound is 4 MiB. A peer streaming
+#: more-fragments past this is buggy or hostile and gets the
+#: connection declared lost, so fragmentation still cannot be used to
+#: grow our memory without bound.
+MAX_MESSAGE = 4 << 20
 
 #: Out-of-order seqs carried per coalesced ACK payload (SACK words).
 #: Far above any window this codebase configures; bounds the payload.

@@ -506,6 +506,9 @@ class MultiLoopCoordinator:
         unbound_ttl: float = 0.0,
         roll_budget: int = 0,
         steal_after: Optional[float] = None,
+        workload_weights: Optional[dict] = None,
+        park_capacity: int = 0,
+        emit_interval: float = 0.5,
         compact_bytes: Optional[int] = None,
     ) -> "MultiLoopCoordinator":
         if loops < 1:
@@ -613,6 +616,11 @@ class MultiLoopCoordinator:
             # and so (ISSUE 18) does a sibling steal of its suffix
             roll_budget=roll_budget,
             steal_after=steal_after,
+            # compute fabric (ISSUE 20): the park queue is shard-local
+            # like the quota buckets it extends — a peer's submissions
+            # park where its address hash steers them
+            workload_weights=workload_weights, park_capacity=park_capacity,
+            emit_interval=emit_interval,
         )
         if retry_after_ms is not None:
             coord_kwargs["retry_after_ms"] = retry_after_ms

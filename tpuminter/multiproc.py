@@ -600,6 +600,9 @@ class MultiProcCoordinator:
         winners_ttl: float = 0.0,
         unbound_ttl: float = 0.0,
         roll_budget: int = 0,
+        workload_weights: Optional[dict] = None,
+        park_capacity: int = 0,
+        emit_interval: float = 0.5,
         log_level: str = "WARNING",
     ) -> "MultiProcCoordinator":
         if procs < 1:
@@ -665,6 +668,10 @@ class MultiProcCoordinator:
             quota_tiers=quota_tiers, max_jobs=max_jobs,
             winners_ttl=winners_ttl, unbound_ttl=unbound_ttl,
             roll_budget=roll_budget,
+            # compute fabric (ISSUE 20): shard-process-local, same
+            # affinity rule as the quota buckets the park queue extends
+            workload_weights=workload_weights, park_capacity=park_capacity,
+            emit_interval=emit_interval,
         )
         if retry_after_ms is not None:
             coord_kwargs["retry_after_ms"] = retry_after_ms

@@ -92,6 +92,15 @@ local fleet. Three extensions ride the same no-flag-day rules:
 - :class:`Steal` — aggregator → coordinator: "my local fleet is idle;
   re-lease me the un-beaconed suffix of a slow sibling's assignment".
   JSON-only (rare by construction).
+
+**Streaming-fold dialect (ISSUE 20).** A client that sets
+``Request.stream`` asks to watch its answer converge: the coordinator
+pushes :class:`Emit` messages — monotone partial fold results gated on
+JOURNALED settles only — at a bounded cadence before the final Result.
+Same no-flag-day rules: ``"strm"`` is an omitted-when-False JSON key an
+old coordinator ignores (the job then simply produces no partials), and
+Emit rides a NEW tag (0xBE) an old client never receives because it
+never asked to stream.
 """
 
 from __future__ import annotations
@@ -115,6 +124,7 @@ __all__ = [
     "RollAssign",
     "Beacon",
     "Steal",
+    "Emit",
     "Refuse",
     "RepHello",
     "SyncFrom",
@@ -259,6 +269,13 @@ class Request:
     coordinator resolves the fold discipline, verifier, and compute
     seam from the registry. Workload chunk answers travel as
     :class:`WorkResult`, not :class:`Result`.
+
+    ``stream`` opts this job into partial-result emission (ISSUE 20):
+    the coordinator pushes :class:`Emit` snapshots of the running fold
+    as journaled settles accumulate, before the final answer. Advisory
+    — an old coordinator ignores the omitted-when-False JSON key and
+    the client just sees the final Result; only workload jobs (those
+    with a fold discipline) ever emit.
     """
 
     job_id: int
@@ -276,6 +293,7 @@ class Request:
     nonce_bits: int = 32
     client_key: str = ""
     workload: str = ""
+    stream: bool = False
 
     @property
     def rolled(self) -> bool:
@@ -465,6 +483,32 @@ class Steal:
 
 
 @dataclass(frozen=True)
+class Emit:
+    """Coordinator → client: a monotone partial result for a streaming
+    workload job (ISSUE 20). Pushed before the final Result when the
+    client's Request set ``stream``; never replaces it — the final
+    Result/WorkResult still arrives and is the authoritative answer.
+
+    ``payload`` is the job's fold discipline encoding of the running
+    accumulator over the JOURNALED settled coverage only — un-durable
+    state is never emitted, so partials can never regress across a
+    coordinator kill -9 + journal replay (replay can only re-reach or
+    extend what was already settled durably). ``covered`` / ``total``
+    are settled-index count vs the job's whole domain span (the
+    coverage fraction a client renders), ``seq`` is a per-job emission
+    counter (strictly increasing; clients drop stale/duplicate seqs on
+    redelivery). ``job_id`` is the CLIENT's job id, like a final
+    Result. Purely advisory: losing every Emit degrades to the classic
+    wait-for-exhaustion behavior."""
+
+    job_id: int
+    seq: int
+    covered: int
+    total: int
+    payload: bytes = b""
+
+
+@dataclass(frozen=True)
 class Refuse:
     """Worker → coordinator: I cannot mine this dispatch (no cached
     template for its job). The recovery seam that keeps the template
@@ -568,7 +612,8 @@ class SyncAck:
 
 Message = Union[
     Join, Request, Result, WorkResult, Cancel, Setup, Assign, RollAssign,
-    Beacon, Steal, Refuse, RepHello, SyncFrom, WalStart, WalBatch, SyncAck,
+    Beacon, Steal, Emit, Refuse, RepHello, SyncFrom, WalStart, WalBatch,
+    SyncAck,
 ]
 
 _KINDS = {
@@ -582,6 +627,7 @@ _KINDS = {
     "rassign": RollAssign,
     "beacon": Beacon,
     "steal": Steal,
+    "emit": Emit,
     "refuse": Refuse,
     "rhello": RepHello,
     "syncfrom": SyncFrom,
@@ -643,6 +689,13 @@ _TAG_WRESULT = 0xBB
 #: each layout lands on a total length no other fixed-size kind uses.
 _TAG_ASSIGN_ROLL_E = 0xBC
 _TAG_BEACON_E = 0xBD
+#: Streaming-fold partial emission (ISSUE 20): the third VARIABLE-
+#: length binary message — ``tag ‖ job:u64 ‖ seq:u64 ‖ covered:u64 ‖
+#: total:u64 ‖ fold payload ‖ crc32``. Like WalBatch/WorkResult the
+#: payload is an opaque already-CRC'd fold frame, the trailing envelope
+#: CRC carries the corruption contract, and distinct-length aliasing
+#: does not apply to a variable-length kind.
+_TAG_EMIT = 0xBE
 
 # Field layouts (little-endian). Every struct is a distinct total size
 # (+4 CRC bytes), so a corrupted tag always fails the length check even
@@ -659,6 +712,8 @@ _BIN_JOIN = struct.Struct("<BBIQ16s")        # tag, flags, lanes, span,
 _BIN_WALBATCH_HEAD = struct.Struct("<BQ")    # tag, offset (data follows)
 _BIN_WRESULT_HEAD = struct.Struct("<BQQBQ")  # tag, job, chunk, wid,
 #                                              searched (payload follows)
+_BIN_EMIT_HEAD = struct.Struct("<BQQQQ")     # tag, job, seq, covered,
+#                                              total (payload follows)
 _BIN_ASSIGN_ROLL = struct.Struct("<BQQQI")   # tag, job, chunk,
 #                                              extranonce0, count
 _BIN_BEACON = struct.Struct("<BQQQQ32s")     # tag, job, chunk,
@@ -816,6 +871,16 @@ def _encode_binary(msg: Message) -> Optional[bytes]:
             )
             + bytes(msg.payload)
         )
+    if isinstance(msg, Emit):
+        if not (0 <= msg.job_id < _U64 and 0 <= msg.seq < _U64
+                and 0 <= msg.covered < _U64 and 0 <= msg.total < _U64):
+            return None
+        return _seal(
+            _BIN_EMIT_HEAD.pack(
+                _TAG_EMIT, msg.job_id, msg.seq, msg.covered, msg.total,
+            )
+            + bytes(msg.payload)
+        )
     return None
 
 
@@ -850,6 +915,20 @@ def _decode_binary(raw) -> Message:
         return WorkResult(
             job_id, chunk_id, wid, searched,
             bytes(view[head : n - _CRC.size]),
+        )
+    if tag == _TAG_EMIT:
+        head = _BIN_EMIT_HEAD.size
+        if n < head + _CRC.size:
+            raise ProtocolError(f"emit payload truncated: {n} bytes")
+        view = memoryview(raw)
+        if (
+            zlib.crc32(view[: n - _CRC.size])
+            != _CRC.unpack_from(raw, n - _CRC.size)[0]
+        ):
+            raise ProtocolError("binary payload failed its checksum")
+        _, job_id, seq, covered, total = _BIN_EMIT_HEAD.unpack_from(raw)
+        return Emit(
+            job_id, seq, covered, total, bytes(view[head : n - _CRC.size]),
         )
     layout = _BIN_BY_TAG.get(tag)
     if layout is None:
@@ -1137,6 +1216,8 @@ def _request_obj(msg: Request) -> dict:
         obj["ckey"] = msg.client_key
     if msg.workload:
         obj["wl"] = msg.workload
+    if msg.stream:
+        obj["strm"] = 1
     return obj
 
 
@@ -1159,6 +1240,7 @@ def _request_from_obj(obj: dict) -> Request:
         nonce_bits=int(obj.get("nonce_bits", 32)),
         client_key=str(obj.get("ckey", "")),
         workload=str(obj.get("wl", "")),
+        stream=bool(obj.get("strm", 0)),
     )
 
 
@@ -1232,6 +1314,15 @@ def encode_msg(msg: Message, *, binary: bool = False) -> bytes:
         obj = {"kind": "steal"}
         if msg.job_id:
             obj["job_id"] = msg.job_id
+    elif isinstance(msg, Emit):
+        obj = {
+            "kind": "emit",
+            "job_id": msg.job_id,
+            "seq": msg.seq,
+            "cov": msg.covered,
+            "tot": msg.total,
+            "wp": bytes(msg.payload).hex(),
+        }
     elif isinstance(msg, Refuse):
         obj = {"kind": "refuse", "job_id": msg.job_id, "chunk_id": msg.chunk_id}
         if msg.retry_after_ms:
@@ -1348,6 +1439,14 @@ def decode_msg(raw) -> Message:
             )
         if kind == "steal":
             return Steal(job_id=int(obj.get("job_id", 0)))
+        if kind == "emit":
+            return Emit(
+                job_id=int(obj["job_id"]),
+                seq=int(obj["seq"]),
+                covered=int(obj["cov"]),
+                total=int(obj["tot"]),
+                payload=bytes.fromhex(obj.get("wp", "")),
+            )
         if kind == "refuse":
             return Refuse(
                 job_id=int(obj["job_id"]), chunk_id=int(obj["chunk_id"]),

@@ -127,7 +127,7 @@ log = logging.getLogger("tpuminter.replication")
 FENCE_JUMP = 1 << 16
 
 #: Largest journal slice per WalBatch. Bounded well under the LSP
-#: reassembly cap (connection.MAX_MESSAGE, 1 MiB) so a batch is a few
+#: reassembly cap (connection.MAX_MESSAGE) so a batch is a few
 #: hundred frames at most; backlog catch-up ships a sequence of these.
 SHIP_BATCH_BYTES = 192 * 1024
 

@@ -318,8 +318,18 @@ async def run_miner(
     connect_epochs: Optional[int] = None,
     roll: bool = True,
     beacon_interval: float = 2.0,
+    clock: Optional[Callable[[], float]] = None,
 ) -> None:
     """Worker role main loop; returns when the coordinator is lost.
+
+    ``clock`` (ISSUE 20) is this worker's monotonic-clock seam —
+    everything time-based on this side (beacon pacing here, redial
+    backoff in :func:`run_miner_reconnect`) reads it, so a chaos cell
+    can install a :class:`tpuminter.chaos.ClockSkewPlan` fork and lie
+    to the worker *differently* than to the coordinator. Skew on this
+    seam may only ever degrade to delays (late beacons, a stretched or
+    hastened redial) — never to wrong results, because no correctness
+    decision on the worker reads the clock.
 
     ≙ reference ``miner.go`` ``main`` (SURVEY.md §3.2), with Cancel
     handling layered in: while a chunk is being mined, an LSP read is kept
@@ -344,6 +354,7 @@ async def run_miner(
     sees one. ``roll=False`` pins this worker to classic global-index
     chunks (the interop tests' "old peer" stand-in).
     """
+    mono = clock if clock is not None else time.monotonic
     client = await LspClient.connect(
         host, port, params or FAST, connect_epochs=connect_epochs
     )
@@ -461,7 +472,7 @@ async def run_miner(
                 )
             else:
                 miner.progress_cb = None
-            last_beacon = time.monotonic()
+            last_beacon = mono()
             beacon_hw = -1
             if msg.workload:
                 # the pluggable-workload compute seam (ISSUE 15): the
@@ -484,7 +495,7 @@ async def run_miner(
                 prog = latest.get("p")
                 if (
                     prog is not None
-                    and time.monotonic() - last_beacon >= beacon_interval
+                    and mono() - last_beacon >= beacon_interval
                 ):
                     hw, bn, bh = prog
                     hw = min(hw, msg.upper)
@@ -495,7 +506,7 @@ async def run_miner(
                             Beacon(msg.job_id, msg.chunk_id, hw, bn, bh),
                             binary=speak_binary,
                         ))
-                        last_beacon = time.monotonic()
+                        last_beacon = mono()
                         beacon_hw = hw
                 if read_task is None:
                     read_task = asyncio.ensure_future(client.read())
@@ -557,6 +568,7 @@ async def run_miner_reconnect(
     addrs: Optional[list] = None,
     roll: bool = True,
     beacon_interval: float = 2.0,
+    clock: Optional[Callable[[], float]] = None,
 ) -> None:
     """Worker serve loop that survives coordinator restarts (ISSUE 3).
 
@@ -595,7 +607,7 @@ async def run_miner_reconnect(
             await run_miner(
                 h, p, miner, params=params, on_result=on_result,
                 binary=binary, connect_epochs=connect_epochs,
-                roll=roll, beacon_interval=beacon_interval,
+                roll=roll, beacon_interval=beacon_interval, clock=clock,
             )
             # had a live session: fresh backoff episode
             delays = jittered_backoff(base_backoff, max_backoff, rng)
@@ -609,7 +621,26 @@ async def run_miner_reconnect(
             "(attempt %d)",
             *targets[dials % len(targets)], wait, dials + 1,
         )
-        await asyncio.sleep(wait)
+        await _sleep_on(clock, wait)
+
+
+async def _sleep_on(
+    clock: Optional[Callable[[], float]], seconds: float
+) -> None:
+    """Sleep ``seconds`` as measured by ``clock`` (the worker-side
+    chaos seam, ISSUE 20): a drifting clock stretches or shrinks the
+    real wait — which is the point, the backoff schedule must only
+    ever degrade to a delayed (or hastened, still jitter-bounded)
+    redial. Without a seam this is a plain sleep."""
+    if clock is None:
+        await asyncio.sleep(seconds)
+        return
+    start = clock()
+    while True:
+        remaining = seconds - (clock() - start)
+        if remaining <= 0:
+            return
+        await asyncio.sleep(min(0.05, max(0.001, remaining)))
 
 
 def _safe_decode(raw: bytes) -> Optional[Message]:

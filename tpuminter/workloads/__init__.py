@@ -43,7 +43,7 @@ from tpuminter.workloads.folds import (  # noqa: F401  (re-exported)
 __all__ = [
     "Workload", "register", "get", "maybe", "by_wid", "names",
     "new_state", "absorb", "absorb_payload", "merge_states", "fold_of",
-    "compute", "verify_claim",
+    "compute", "verify_claim", "window_for", "chunk_cap", "covered_span",
     "Fold", "FMin", "TopK", "FirstMatch", "FSum",
 ]
 
@@ -72,6 +72,22 @@ class Workload:
         """Off-loop check of a decoded chunk partial against this
         chunk-Request's exact [lower, upper] range."""
         raise NotImplementedError
+
+    def window(self, request, lo: int, hi: int) -> Optional[bytes]:
+        """Opaque-domain chunking seam (ISSUE 20): return a params
+        frame carrying ONLY what indices ``[lo, hi]`` need (a slice of
+        a shipped candidate list), or None when this workload's params
+        are already range-independent (the default) and the cached
+        full-job Setup suffices. A non-None return makes the
+        coordinator ship a per-chunk Setup whose ``data`` is the
+        window, so a 100k-candidate catalog never rides one dispatch."""
+        return None
+
+    def chunk_cap(self, request) -> int:
+        """Upper bound on indices per dispatch for this job (0 = no
+        bound, the default). Opaque-domain workloads derive it from a
+        per-window byte budget so windowed Setups stay datagram-sized."""
+        return 0
 
 
 # ---------------------------------------------------------------------------
@@ -232,6 +248,38 @@ def verify_claim(request, msg) -> bool:
     return workload.verify(request, fold, acc)
 
 
+def window_for(request, lo: int, hi: int) -> Optional[bytes]:
+    """The coordinator-side chunking seam: the params window covering
+    ``[lo, hi]`` of this job, or None when the cached full-job template
+    already serves every chunk (unknown workloads and malformed params
+    also answer None — dispatch then proceeds classically and the
+    worker refuses or fails verification downstream)."""
+    workload = _REGISTRY.get(getattr(request, "workload", "") or "")
+    if workload is None:
+        return None
+    try:
+        return workload.window(request, lo, hi)
+    except ValueError:
+        return None
+
+
+def chunk_cap(request) -> int:
+    """Per-dispatch index cap for this job (0 = unbounded)."""
+    workload = _REGISTRY.get(getattr(request, "workload", "") or "")
+    if workload is None:
+        return 0
+    try:
+        return max(0, int(workload.chunk_cap(request)))
+    except ValueError:
+        return 0
+
+
+def covered_span(state: Optional[dict]) -> int:
+    """Settled-index count of a fold state (0 for None) — the
+    numerator of a streaming Emit's coverage fraction."""
+    return _span(state["covered"]) if state else 0
+
+
 def absorb_payload(
     request, state: Optional[dict], lo: int, hi: int, payload: bytes
 ) -> Tuple[Optional[dict], bool]:
@@ -255,3 +303,4 @@ def absorb_payload(
 # built-in workloads self-register on import (bottom import: the
 # registry API above must exist before hashcore's module body runs)
 from tpuminter.workloads import hashcore  # noqa: E402,F401
+from tpuminter.workloads import dictsearch  # noqa: E402,F401

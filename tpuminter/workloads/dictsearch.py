@@ -1,0 +1,339 @@
+"""Dictionary/candidate-list search: the opaque-domain third workload
+(ISSUE 20; PNPCoin, arXiv:2208.12628, is the "general compute on the
+mining fabric" direction the registry points at).
+
+HashCore proved the registry's seams with a domain that is still an
+integer range — ``objective(seed, index)`` needs nothing but the index.
+This workload's domain is a *shipped list*: a passphrase-candidate
+sweep where ``score(seed, candidate)`` is the low 64 bits of
+``SHA-256(seed ‖ candidate)`` and the candidates ride ``Request.data``
+as opaque bytes. The coordinator still carves, journals, replays, and
+folds over *indices into the list* — global index ``i`` scores
+``entries[i]`` — so exactly-once (coverage-gated folds, interval
+subtraction, dedup, failover) composes unchanged while the codec seam
+finally carries non-trivial opaque payloads end-to-end.
+
+**Windowed dispatch.** A 100k-candidate catalog must not ride every
+chunk Setup, so this module implements the registry's opaque-domain
+chunking seam: :meth:`DictSearch.window` re-packs ONLY the entries a
+chunk ``[lo, hi]`` needs (``base`` in the frame maps global indices to
+window slots) and :meth:`DictSearch.chunk_cap` bounds indices-per-
+dispatch by a per-window byte budget, so the coordinator ships small
+per-chunk Setups instead of the full catalog. LSP's ordered delivery
+guarantees each windowed Setup precedes its Assign, and the worker's
+template cache simply overwrites — no worker change needed.
+
+Params codec: ``tag ‖ variant:u8 ‖ seed:u64 ‖ threshold:u64 ‖ k:u8 ‖
+base:u64 ‖ count:u32 ‖ count × (len:u16 ‖ bytes) ‖ crc32`` — tag 0xC5
+in the process-wide namespace, variable length (the ``_HEAD`` layout
+carries the fixed prefix; the entry table follows), CRC-trailed like
+every other frame in the process.
+
+Verification mirrors hashcore's trust model per variant: fmin/topk
+verify witnesses (claimed (value, index) recomputes against the full
+catalog and lies in the chunk range), fmatch and fsum are decidable so
+they get full recompute proofs (a dry first-match claim rescans the
+whole chunk). All of it runs in the coordinator's verification
+executor, never on the serve loop.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+import zlib
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from tpuminter.workloads import Workload, register
+from tpuminter.workloads import folds
+
+__all__ = [
+    "DictSearch", "DictParams", "score", "pack_params", "parse_params",
+    "VARIANTS", "DICT_WID", "MAX_CANDIDATES", "MAX_ENTRY",
+]
+
+#: Compact workload id on binary WorkResult frames (hashcore owns 1;
+#: the analysis suite flags cross-module collisions).
+DICT_WID = 2
+
+_U64 = 1 << 64
+
+#: Params codec fixed prefix: tag ‖ variant:u8 ‖ seed:u64 ‖
+#: threshold:u64 ‖ k:u8 ‖ base:u64 ‖ count:u32 (entry table follows,
+#: then crc32 — a VARIABLE-length frame, so like WalBatch the trailing
+#: CRC alone carries the corruption contract).
+_TAG_DICTPARAMS = 0xC5
+_BIN_DICTPARAMS_HEAD = struct.Struct("<BBQQBQI")
+_LEN = struct.Struct("<H")
+_CRC = struct.Struct("<I")
+
+VARIANTS = ("fmin", "topk", "fmatch", "fsum")
+
+#: Hard bounds on what a params frame may carry: entries are u16
+#: length-prefixed and a catalog is capped well below the journal's
+#: 8 MB record bound (a 2^20-entry catalog of short passphrases is a
+#: few MB; anything larger should be split into jobs by the client).
+MAX_CANDIDATES = 1 << 20
+MAX_ENTRY = 512
+
+#: Per-window byte budget for chunked dispatch: windowed Setups stay a
+#: few LSP fragments, far under the connection's reassembly cap.
+WINDOW_BYTES = 32 * 1024
+
+#: Cooperative batch width — smaller than hashcore's: one SHA-256 per
+#: candidate is ~30x a splitmix64 mix, and the yield cadence is what
+#: keeps the worker's executor loop cancellable.
+_BATCH = 256
+
+
+def score(seed: int, candidate: bytes) -> int:
+    """u64 LE of ``SHA-256(seed_le8 ‖ candidate)`` — deterministic and
+    stateless per candidate, so any chunk partition folds exactly."""
+    digest = hashlib.sha256(
+        seed.to_bytes(8, "little") + bytes(candidate)
+    ).digest()
+    return int.from_bytes(digest[:8], "little")
+
+
+def _seal(body: bytes) -> bytes:
+    return body + _CRC.pack(zlib.crc32(body))
+
+
+def pack_params(
+    variant: str,
+    seed: int,
+    candidates,
+    threshold: int = 0,
+    k: int = 1,
+    base: int = 0,
+) -> bytes:
+    """Encode job params for ``Request.data``. A full-job frame has
+    ``base=0``; window frames (coordinator → worker per-chunk Setups)
+    carry ``base=lo`` and only the slice a chunk needs."""
+    if variant not in VARIANTS:
+        raise ValueError(f"dictsearch: unknown variant {variant!r}")
+    if not (0 <= seed < _U64 and 0 <= threshold < _U64
+            and 0 <= base < _U64):
+        raise ValueError("dictsearch: seed/threshold/base out of u64 range")
+    if not 1 <= k <= folds.TOPK_SLOTS:
+        raise ValueError(f"dictsearch: k must be in [1, {folds.TOPK_SLOTS}]")
+    entries = [bytes(c) for c in candidates]
+    if not 1 <= len(entries) <= MAX_CANDIDATES:
+        raise ValueError(
+            f"dictsearch: candidate count must be in [1, {MAX_CANDIDATES}]"
+        )
+    parts = [_BIN_DICTPARAMS_HEAD.pack(
+        _TAG_DICTPARAMS, VARIANTS.index(variant), seed, threshold, k,
+        base, len(entries),
+    )]
+    for entry in entries:
+        if len(entry) > MAX_ENTRY:
+            raise ValueError(
+                f"dictsearch: entry exceeds {MAX_ENTRY} bytes"
+            )
+        parts.append(_LEN.pack(len(entry)))
+        parts.append(entry)
+    return _seal(b"".join(parts))
+
+
+@dataclass(frozen=True)
+class DictParams:
+    variant: str
+    seed: int
+    threshold: int
+    k: int
+    #: Global index of ``entries[0]`` — 0 on full-job frames, the chunk
+    #: lower bound on window frames.
+    base: int
+    entries: Tuple[bytes, ...]
+
+    def entry(self, index: int) -> bytes:
+        """The candidate at GLOBAL index ``index``; raises ValueError
+        when the index falls outside this frame's window."""
+        slot = index - self.base
+        if not 0 <= slot < len(self.entries):
+            raise ValueError(
+                f"dictsearch: index {index} outside window "
+                f"[{self.base}, {self.base + len(self.entries) - 1}]"
+            )
+        return self.entries[slot]
+
+
+#: Parsed-catalog LRU: ``fold_for``/``verify`` run once per settle and
+#: re-parsing a multi-MB catalog each time would dominate; keyed by the
+#: exact frame bytes so a window frame and its full-job parent coexist.
+_PARSE_CACHE: "OrderedDict[bytes, DictParams]" = OrderedDict()
+_PARSE_CACHE_CAP = 8
+
+
+def parse_params(data: bytes) -> DictParams:
+    """Decode + validate a params frame. Raises ValueError on anything
+    malformed — the coordinator Refuses the Request."""
+    key = bytes(data)
+    hit = _PARSE_CACHE.get(key)
+    if hit is not None:
+        _PARSE_CACHE.move_to_end(key)
+        return hit
+    head = _BIN_DICTPARAMS_HEAD.size
+    if len(key) < head + _CRC.size:
+        raise ValueError(f"dictsearch params: truncated ({len(key)} bytes)")
+    body, (crc,) = key[:-_CRC.size], _CRC.unpack(key[-_CRC.size:])
+    if zlib.crc32(body) != crc:
+        raise ValueError("dictsearch params: CRC mismatch")
+    tag, variant, seed, threshold, k, base, count = (
+        _BIN_DICTPARAMS_HEAD.unpack_from(body)
+    )
+    if tag != _TAG_DICTPARAMS:
+        raise ValueError(f"dictsearch params: tag 0x{tag:02X}")
+    if variant >= len(VARIANTS):
+        raise ValueError(f"dictsearch params: unknown variant {variant}")
+    if not 1 <= k <= folds.TOPK_SLOTS:
+        raise ValueError("dictsearch params: k out of range")
+    if not 1 <= count <= MAX_CANDIDATES:
+        raise ValueError(f"dictsearch params: bad candidate count {count}")
+    entries: List[bytes] = []
+    off = head
+    for _ in range(count):
+        if off + _LEN.size > len(body):
+            raise ValueError("dictsearch params: entry table truncated")
+        (n,) = _LEN.unpack_from(body, off)
+        off += _LEN.size
+        if n > MAX_ENTRY or off + n > len(body):
+            raise ValueError("dictsearch params: entry overruns the frame")
+        entries.append(body[off : off + n])
+        off += n
+    if off != len(body):
+        raise ValueError("dictsearch params: trailing bytes after entries")
+    parsed = DictParams(
+        VARIANTS[variant], seed, threshold, k, base, tuple(entries)
+    )
+    _PARSE_CACHE[key] = parsed
+    if len(_PARSE_CACHE) > _PARSE_CACHE_CAP:
+        _PARSE_CACHE.popitem(last=False)
+    return parsed
+
+
+class DictSearch(Workload):
+    name = "dict"
+    wid = DICT_WID
+
+    def fold_for(self, request) -> folds.Fold:
+        p = parse_params(request.data)
+        # the opaque-domain range check: a Request may only carve
+        # indices its frame actually ships
+        if (request.lower < p.base
+                or request.upper >= p.base + len(p.entries)):
+            raise ValueError(
+                "dictsearch: request range outside the shipped catalog"
+            )
+        if p.variant == "fmin":
+            return folds.FMin()
+        if p.variant == "topk":
+            return folds.TopK(p.k)
+        if p.variant == "fmatch":
+            return folds.FirstMatch(p.threshold)
+        return folds.FSum()
+
+    def window(self, request, lo: int, hi: int) -> Optional[bytes]:
+        p = parse_params(request.data)
+        if len(request.data) <= WINDOW_BYTES:
+            return None  # the cached full-job Setup is already small
+        if not (p.base <= lo <= hi < p.base + len(p.entries)):
+            raise ValueError("dictsearch: window outside the catalog")
+        return pack_params(
+            p.variant, p.seed,
+            p.entries[lo - p.base : hi - p.base + 1],
+            threshold=p.threshold, k=p.k, base=lo,
+        )
+
+    def chunk_cap(self, request) -> int:
+        p = parse_params(request.data)
+        if len(request.data) <= WINDOW_BYTES:
+            return 0
+        avg = max(1, len(request.data) // max(1, len(p.entries)))
+        return max(16, WINDOW_BYTES // (avg + _LEN.size))
+
+    def compute(self, request, fold: folds.Fold, engine: str = "cpu"):
+        """Generic batch scan, same shape as hashcore: ``of_batch`` +
+        ``combine`` with first-match early-stop; the engine seam is
+        moot (SHA-256 over ragged byte strings stays on host lanes)."""
+        p = parse_params(request.data)
+        lo, hi = request.lower, request.upper
+        acc, searched = fold.initial(), 0
+        index = lo
+        while index <= hi:
+            last = min(hi, index + _BATCH - 1)
+            values = [
+                score(p.seed, p.entry(j)) for j in range(index, last + 1)
+            ]
+            acc = fold.combine(acc, fold.of_batch(index, values))
+            searched += last - index + 1
+            if fold.is_final(acc):
+                break
+            index = last + 1
+            yield None
+        return searched, acc
+
+    def verify(self, request, fold: folds.Fold, acc) -> bool:
+        p = parse_params(request.data)
+        lo, hi = request.lower, request.upper
+        if lo > hi:
+            return False
+
+        def value_at(index: int) -> Optional[int]:
+            try:
+                return score(p.seed, p.entry(index))
+            except ValueError:
+                return None
+
+        if isinstance(fold, folds.FMin):
+            if acc is None:
+                return False
+            value, index = acc
+            return lo <= index <= hi and value_at(index) == value
+        if isinstance(fold, folds.TopK):
+            want = min(p.k, hi - lo + 1)
+            if len(acc) != want or sorted(map(tuple, acc)) != list(
+                map(tuple, acc)
+            ):
+                return False
+            if len({index for _v, index in acc}) != len(acc):
+                return False
+            return all(
+                lo <= index <= hi and value_at(index) == value
+                for value, index in acc
+            )
+        if isinstance(fold, folds.FirstMatch):
+            if acc is None:
+                return False  # a dispatched chunk always scans something
+            index, value, probes = acc
+            if index is None:
+                # absence is decidable: a dry claim must cover the
+                # whole chunk and survive a full rescan
+                return probes == hi - lo + 1 and all(
+                    value_at(j) is not None and value_at(j) > p.threshold
+                    for j in range(lo, hi + 1)
+                )
+            if not (lo <= index <= hi and value <= p.threshold
+                    and value_at(index) == value
+                    and probes == index - lo + 1):
+                return False
+            # "first" is part of the claim: the prefix must be dry
+            return all(
+                value_at(j) is not None and value_at(j) > p.threshold
+                for j in range(lo, index)
+            )
+        if isinstance(fold, folds.FSum):
+            total, count = acc
+            if count != hi - lo + 1:
+                return False
+            values = [value_at(j) for j in range(lo, hi + 1)]
+            if any(v is None for v in values):
+                return False
+            return total == sum(values)
+        return False
+
+
+register(DictSearch())
